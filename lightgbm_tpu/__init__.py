@@ -1,0 +1,41 @@
+"""lightgbm_tpu: a TPU-native gradient-boosting framework.
+
+Re-designed from scratch for JAX/XLA/Pallas with the capabilities of
+LightGBM v2.2.4 (reference: mark5434/LightGBM): histogram-based GBDT with
+leaf-wise growth, EFB-style binning, GOSS/DART/RF boosting modes, the full
+objective/metric suite, distributed training over jax.sharding meshes, and a
+LightGBM-compatible Python API and model format.
+"""
+
+from .config import Config
+from .log import Log, LightGBMError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config", "Log", "LightGBMError",
+    "Dataset", "Booster", "train", "cv",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+]
+
+
+def __getattr__(name):
+    # lazy imports keep `import lightgbm_tpu` light and avoid jax init at
+    # import time for tooling that only wants Config/version
+    if name in ("Dataset", "Booster"):
+        from . import basic
+        return getattr(basic, name)
+    if name in ("train", "cv"):
+        from . import engine
+        return getattr(engine, name)
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    if name in ("plot_importance", "plot_metric", "plot_tree", "create_tree_digraph"):
+        from . import plotting
+        return getattr(plotting, name)
+    if name in ("early_stopping", "print_evaluation", "record_evaluation",
+                "reset_parameter"):
+        from . import callback
+        return getattr(callback, name)
+    raise AttributeError("module 'lightgbm_tpu' has no attribute %r" % name)

@@ -1,0 +1,615 @@
+"""GBDT training driver.
+
+TPU-native re-design of src/boosting/gbdt.cpp (Init :45-115, TrainOneIter
+:333-412, Bagging :159-241, UpdateScore :451-470, early stopping :476-533).
+The whole boosting iteration — gradients, bagging mask, K class trees, score
+update — is one jit-compiled function; the host loop only sequences
+iterations, snapshots tiny tree arrays, and runs metrics every
+``metric_freq`` rounds.
+
+Key mappings:
+- ScoreUpdater (score_updater.hpp) -> a device score array updated via the
+  final per-row ``leaf_id`` from growth (the "by learner partition" fast path,
+  serial_tree_learner.h:58-70) — out-of-bag rows get their leaf the same way,
+  so no separate OOB pass is needed.
+- Multiclass K trees/iteration (gbdt.cpp:348-398) -> ``jax.vmap`` of tree
+  growth over the class axis.
+- Tree::Shrinkage (tree.h:139) -> leaf values scaled by learning_rate when a
+  tree is extracted into the host-side model list.
+- RenewTreeOutput for percentile objectives (serial_tree_learner.cpp:850-928)
+  -> host-side weighted percentile per leaf (device port planned).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..log import Log, LightGBMError, check
+from ..io.dataset import BinnedDataset
+from ..io.binning import BinType, MissingType as BinMissingType
+from ..core.split import FeatureMeta, SplitParams
+from ..core.grow import GrowParams, TreeArrays, grow_tree
+from ..core import tree as tree_mod
+from ..objectives import ObjectiveFunction
+from ..metrics import Metric
+
+
+class HostTree:
+    """One trained tree pulled to host: numpy SoA + real-value thresholds.
+
+    The analog of the serialized Tree model (tree.h:404-517) — what gets
+    saved, loaded, and used for raw-input prediction.
+    """
+
+    def __init__(self, num_leaves: int):
+        n = max(num_leaves - 1, 1)
+        self.num_leaves = num_leaves
+        self.split_feature = np.zeros(n, np.int32)       # real feature index
+        self.split_gain = np.zeros(n, np.float32)
+        self.threshold = np.zeros(n, np.float64)         # real-value threshold
+        self.threshold_bin = np.zeros(n, np.int32)
+        self.default_left = np.zeros(n, bool)
+        self.missing_type = np.zeros(n, np.int32)
+        self.is_categorical = np.zeros(n, bool)
+        self.cat_bitset = np.zeros((n, 8), np.uint32)
+        self.left_child = np.full(n, -1, np.int32)
+        self.right_child = np.full(n, -1, np.int32)
+        self.split_leaf = np.full(n, -1, np.int32)
+        self.internal_value = np.zeros(n, np.float64)
+        self.internal_weight = np.zeros(n, np.float64)
+        self.internal_count = np.zeros(n, np.int64)
+        self.leaf_value = np.zeros(num_leaves, np.float64)
+        self.leaf_weight = np.zeros(num_leaves, np.float64)
+        self.leaf_count = np.zeros(num_leaves, np.int64)
+        self.shrinkage = 1.0
+
+    @property
+    def num_nodes(self) -> int:
+        return self.num_leaves - 1
+
+    def shrink(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:139-147)."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def predict_table(self, max_nodes: int, max_leaves: int) -> tree_mod.PredictTree:
+        """Pad to model-wide fixed shapes for stacked device prediction."""
+        def pad(a, n, fill=0):
+            out = np.full((n,) + a.shape[1:], fill, a.dtype)
+            out[:len(a)] = a
+            return out
+        return tree_mod.PredictTree(
+            split_leaf=pad(self.split_leaf, max_nodes, -1),
+            split_feature=pad(self.split_feature, max_nodes),
+            threshold=pad(self.threshold.astype(np.float32), max_nodes),
+            threshold_bin=pad(self.threshold_bin, max_nodes),
+            default_left=pad(self.default_left, max_nodes),
+            missing_type=pad(self.missing_type, max_nodes),
+            is_categorical=pad(self.is_categorical, max_nodes),
+            cat_bitset=pad(self.cat_bitset, max_nodes),
+            leaf_value=pad(self.leaf_value.astype(np.float32), max_leaves),
+        )
+
+
+def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta:
+    f = ds.num_features
+    num_bin = np.array([ds.feature_num_bin(j) for j in range(f)], np.int32)
+    missing = np.array(
+        [ds.bin_mappers[ds.used_features[j]].missing_type for j in range(f)],
+        np.int32)
+    default_bin = np.array(
+        [ds.bin_mappers[ds.used_features[j]].default_bin for j in range(f)],
+        np.int32)
+    is_cat = np.array(
+        [ds.bin_mappers[ds.used_features[j]].bin_type == BinType.CATEGORICAL
+         for j in range(f)], bool)
+    penalty = np.ones(f, np.float32)
+    if config.feature_contri:
+        fc = np.asarray(config.feature_contri, np.float32)
+        for j in range(f):
+            rj = ds.used_features[j]
+            if rj < len(fc):
+                penalty[j] = fc[rj]
+    return FeatureMeta(
+        num_bin=jnp.asarray(num_bin), missing_type=jnp.asarray(missing),
+        default_bin=jnp.asarray(default_bin), is_categorical=jnp.asarray(is_cat),
+        penalty=jnp.asarray(penalty))
+
+
+class GBDT:
+    """Boosting driver (include/LightGBM/boosting.h:22-294, gbdt.{h,cpp})."""
+
+    boosting_type = "gbdt"
+    average_output = False
+
+    def __init__(self, config: Config, train_data: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction],
+                 metrics: Optional[List[Metric]] = None):
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.train_metrics = metrics or []
+        self.valid_data: List[BinnedDataset] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.models: List[HostTree] = []
+        self.iter_ = 0
+        self.num_init_iteration = 0
+        self.best_score: Dict[Any, Dict[str, float]] = {}
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective is not None
+            else max(1, config.num_class))
+        self.shrinkage_rate = config.learning_rate
+
+        if train_data is not None:
+            self._setup_train(train_data)
+
+    # ------------------------------------------------------------ setup
+    def _setup_train(self, ds: BinnedDataset) -> None:
+        cfg = self.config
+        self.num_data = ds.num_data
+        self.feature_meta = _feature_meta_from_dataset(ds, cfg)
+        self.num_bins = max(ds.max_num_bin(), 2)
+        self.xb = jnp.asarray(ds.X_binned)
+        if self.objective is not None:
+            self.objective.init(ds.metadata, ds.num_data)
+        for m in self.train_metrics:
+            m.init(ds.metadata, ds.num_data)
+
+        self.grow_params = GrowParams(
+            num_leaves=cfg.num_leaves,
+            num_bins=self.num_bins,
+            max_depth=cfg.max_depth,
+            split=SplitParams(
+                lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+                max_delta_step=cfg.max_delta_step,
+                min_data_in_leaf=cfg.min_data_in_leaf,
+                min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+                min_gain_to_split=cfg.min_gain_to_split,
+                max_cat_threshold=cfg.max_cat_threshold,
+                cat_smooth=cfg.cat_smooth, cat_l2=cfg.cat_l2,
+                max_cat_to_onehot=cfg.max_cat_to_onehot,
+                min_data_per_group=cfg.min_data_per_group),
+            row_chunk=16384,
+            hist_impl=("scatter" if jax.default_backend() == "cpu" else "matmul"))
+
+        k = self.num_tree_per_iteration
+        n = self.num_data
+        init_scores = np.zeros((n, k), np.float32)
+        # init score from file/metadata (ScoreUpdater ctor :32-51)
+        if ds.metadata.init_score is not None:
+            isc = np.asarray(ds.metadata.init_score, np.float32).reshape(-1)
+            if len(isc) == n * k:
+                init_scores = isc.reshape(k, n).T.copy()
+            else:
+                init_scores = np.tile(isc.reshape(-1, 1), (1, k))
+        self._init_scores_provided = ds.metadata.init_score is not None
+        self.scores = jnp.asarray(init_scores)
+        self.boost_from_average_done = False
+        self._rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
+        self._bag_mask = jnp.ones((n,), jnp.float32)
+        self._compiled_iter = None
+        self._valid_pred_cache: Dict[int, jnp.ndarray] = {}
+
+    def add_valid_data(self, ds: BinnedDataset, metrics: List[Metric]) -> None:
+        for m in metrics:
+            m.init(ds.metadata, ds.num_data)
+        self.valid_data.append(ds)
+        self.valid_metrics.append(metrics)
+        # device copy of binned valid features + running scores
+        k = self.num_tree_per_iteration
+        init = np.zeros((ds.num_data, k), np.float32)
+        if ds.metadata.init_score is not None:
+            isc = np.asarray(ds.metadata.init_score, np.float32).reshape(-1)
+            if len(isc) == ds.num_data * k:
+                init = isc.reshape(k, ds.num_data).T.copy()
+            else:
+                init = np.tile(isc.reshape(-1, 1), (1, k))
+        self._valid_pred_cache[len(self.valid_data) - 1] = {
+            "xb": jnp.asarray(ds.X_binned),
+            "scores": jnp.asarray(init),
+        }
+
+    # ------------------------------------------------------------ training
+    def _boost_from_average(self) -> None:
+        """gbdt.cpp:298-331: seed scores with the objective's init score."""
+        if (self.boost_from_average_done or self.objective is None
+                or not self.config.boost_from_average
+                or self._init_scores_provided):
+            self.boost_from_average_done = True
+            return
+        k = self.num_tree_per_iteration
+        inits = np.array([self.objective.boost_from_score(c) for c in range(k)],
+                         np.float32)
+        if np.any(inits != 0):
+            self.scores = self.scores + jnp.asarray(inits)[None, :]
+            for vd in self._valid_pred_cache.values():
+                vd["scores"] = vd["scores"] + jnp.asarray(inits)[None, :]
+            self.init_score_offsets = inits
+        else:
+            self.init_score_offsets = np.zeros(k, np.float32)
+        self.boost_from_average_done = True
+
+    def _sample_feature_mask(self) -> jnp.ndarray:
+        """Per-tree column sampling (serial_tree_learner.cpp:271-292)."""
+        f = self.train_data.num_features
+        frac = self.config.feature_fraction
+        if frac >= 1.0 or f == 0:
+            return jnp.ones((f,), bool)
+        used = max(1, int(f * frac))
+        idx = self._rng.choice(f, used, replace=False)
+        mask = np.zeros(f, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    def _sample_bagging_mask(self, iter_idx: int) -> jnp.ndarray:
+        """Row bagging (gbdt.cpp:180-241); resampled every bagging_freq."""
+        cfg = self.config
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return self._bag_mask
+        if iter_idx % cfg.bagging_freq == 0:
+            self._bag_key, sub = jax.random.split(self._bag_key)
+            u = jax.random.uniform(sub, (self.num_data,))
+            self._bag_mask = (u < cfg.bagging_fraction).astype(jnp.float32)
+        return self._bag_mask
+
+    def _make_train_iter_fn(self) -> Callable:
+        """Build the jitted per-iteration function."""
+        meta = self.feature_meta
+        params = self.grow_params
+        xb = self.xb
+        obj = self.objective
+        k = self.num_tree_per_iteration
+        lr = self.shrinkage_rate
+
+        @jax.jit
+        def run_iter(scores, sample_mask, feature_mask,
+                     grad_in, hess_in):
+            # gradients: objective or custom (grad_in) (gbdt.cpp:333-347)
+            if obj is not None:
+                if k == 1:
+                    g, h = obj.get_gradients(scores[:, 0])
+                    g = g[:, None]
+                    h = h[:, None]
+                else:
+                    g, h = obj.get_gradients(scores)
+            else:
+                g, h = grad_in, hess_in
+
+            def grow_one(gk, hk):
+                return grow_tree(xb, gk, hk, sample_mask, meta, feature_mask,
+                                 params)
+
+            trees, leaf_ids = jax.vmap(grow_one, in_axes=(1, 1))(g, h)
+            # score update fast path: leaf_id -> leaf_value (shrinkage applied)
+            deltas = jax.vmap(
+                lambda t, li: t.leaf_value[li] * lr)(trees, leaf_ids)  # [K, N]
+            new_scores = scores + deltas.T
+            return trees, leaf_ids, new_scores, g, h
+
+        return run_iter
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (gbdt.cpp TrainOneIter:333-412).
+
+        Returns True when training should stop (no splittable tree).
+        """
+        self._boost_from_average()
+        if self._compiled_iter is None:
+            self._compiled_iter = self._make_train_iter_fn()
+
+        iter_idx = self.iter_
+        sample_mask = self._sample_bagging_mask(iter_idx)
+        feature_mask = self._sample_feature_mask()
+
+        n, k = self.num_data, self.num_tree_per_iteration
+        if grad is not None:
+            g_in = jnp.asarray(np.asarray(grad, np.float32).reshape(k, n).T
+                               if np.asarray(grad).ndim == 1 and k > 1
+                               else np.asarray(grad, np.float32).reshape(n, k))
+            h_in = jnp.asarray(np.asarray(hess, np.float32).reshape(k, n).T
+                               if np.asarray(hess).ndim == 1 and k > 1
+                               else np.asarray(hess, np.float32).reshape(n, k))
+        else:
+            g_in = jnp.zeros((n, k), jnp.float32)
+            h_in = jnp.ones((n, k), jnp.float32)
+
+        trees, leaf_ids, new_scores, g, h = self._compiled_iter(
+            self.scores, sample_mask, feature_mask, g_in, h_in)
+
+        # pull tree arrays to host, convert thresholds, store
+        trees_np = jax.tree.map(np.asarray, trees)
+        any_split = False
+        host_trees = []
+        for c in range(k):
+            t = jax.tree.map(lambda a: a[c], trees_np)
+            ht = self._extract_host_tree(t)
+            if ht.num_leaves_actual > 1:
+                any_split = True
+            host_trees.append(ht)
+
+        if not any_split:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if not self.models:
+                # keep a constant tree so the model reproduces the init score
+                # (AsConstantTree path, gbdt.cpp:379-396)
+                inits = getattr(self, "init_score_offsets",
+                                np.zeros(k, np.float32))
+                for c in range(k):
+                    ht = host_trees[c]
+                    ht.num_leaves_actual = 1
+                    ht.leaf_value[:] = 0.0
+                    ht.leaf_value[0] = float(inits[c])
+                    ht.split_leaf[:] = -1
+                    self.models.append(ht)
+            return True
+
+        # leaf renewal for percentile objectives (RenewTreeOutput,
+        # serial_tree_learner.cpp:850-928)
+        if getattr(self.objective, "renew_percentile", None) is not None:
+            new_scores = self._renew_tree_outputs(host_trees, leaf_ids,
+                                                  sample_mask)
+        self.scores = new_scores
+
+        first_iter = not self.models
+        for ht in host_trees:
+            ht.shrink(self.shrinkage_rate)
+        # valid scores get the shrunk tree output (pre-bias; their init score
+        # was added by _boost_from_average already)
+        self._update_valid_scores(host_trees)
+        if first_iter:
+            # fold the init score into the first iteration's trees so the
+            # saved model is self-contained (AddBias, gbdt.cpp:374-376)
+            inits = getattr(self, "init_score_offsets", np.zeros(k, np.float32))
+            for c, ht in enumerate(host_trees):
+                if abs(float(inits[c])) > 1e-15:
+                    ht.leaf_value += float(inits[c])
+                    ht.internal_value += float(inits[c])
+        self.models.extend(host_trees)
+        self.iter_ += 1
+        return False
+
+    def _renew_tree_outputs(self, host_trees: List[HostTree],
+                            leaf_ids, sample_mask) -> jnp.ndarray:
+        """Percentile leaf refit for L1/quantile/MAPE objectives
+        (regression_objective.hpp RenewTreeOutput; host-side for now)."""
+        alpha = self.objective.renew_percentile()
+        label = np.asarray(self.objective.label)
+        w = (np.asarray(self.objective.weights)
+             if self.objective.weights is not None else np.ones_like(label))
+        if hasattr(self.objective, "label_weight") and \
+                self.objective.name == "mape":
+            w = np.asarray(self.objective.label_weight)
+        scores_np = np.asarray(self.scores)
+        leaf_ids_np = np.asarray(leaf_ids)
+        mask = np.asarray(sample_mask) > 0
+        k = self.num_tree_per_iteration
+        from ..objectives import _weighted_percentile
+        for c in range(k):
+            ht = host_trees[c]
+            resid = label - scores_np[:, c]
+            li = leaf_ids_np[c]
+            for leaf in range(ht.num_leaves_actual):
+                sel = (li == leaf) & mask
+                if sel.any():
+                    ht.leaf_value[leaf] = _weighted_percentile(
+                        resid[sel], w[sel], alpha)
+            # rebuild score delta with renewed (pre-shrinkage) values; the
+            # shrinkage is applied when the tree is stored
+            scores_np[:, c] += ht.leaf_value[li] * self.shrinkage_rate
+        return jnp.asarray(scores_np)
+
+    def _extract_host_tree(self, t) -> HostTree:
+        """TreeArrays (device) -> HostTree with real thresholds."""
+        ds = self.train_data
+        l = self.config.num_leaves
+        ht = HostTree(l)
+        nl = int(t.num_leaves)
+        ht.num_leaves_actual = nl
+        nn = nl - 1
+        used = np.arange(nn)
+        inner_feat = t.split_feature[:nn].astype(np.int64)
+        ht.split_feature[:nn] = np.array(
+            [ds.real_feature_index(int(j)) for j in inner_feat], np.int32)
+        ht.split_gain[:nn] = t.split_gain[:nn]
+        ht.threshold_bin[:nn] = t.threshold_bin[:nn]
+        for i in range(nn):
+            mapper = ds.bin_mappers[int(ht.split_feature[i])]
+            if bool(t.is_categorical[i]):
+                ht.threshold[i] = 0.0
+            else:
+                tb = int(t.threshold_bin[i])
+                ht.threshold[i] = mapper.bin_to_value(tb)
+        ht.default_left[:nn] = t.default_left[:nn]
+        ht.missing_type[:nn] = t.missing_type[:nn]
+        ht.is_categorical[:nn] = t.is_categorical[:nn]
+        ht.cat_bitset[:nn] = t.cat_bitset[:nn]
+        ht.left_child[:nn] = t.left_child[:nn]
+        ht.right_child[:nn] = t.right_child[:nn]
+        ht.split_leaf[:nn] = t.split_leaf[:nn]
+        ht.internal_value[:nn] = t.internal_value[:nn]
+        ht.internal_weight[:nn] = t.internal_weight[:nn]
+        ht.internal_count[:nn] = np.round(t.internal_count[:nn]).astype(np.int64)
+        ht.leaf_value[:] = t.leaf_value[:l]
+        ht.leaf_weight[:] = t.leaf_weight[:l]
+        ht.leaf_count[:] = np.round(t.leaf_count[:l]).astype(np.int64)
+        return ht
+
+    # ------------------------------------------------------------ scoring
+    def _update_valid_scores(self, host_trees: List[HostTree]) -> None:
+        """Add the new trees' output to each valid set's running scores via
+        binned replay (ScoreUpdater::AddScore whole-tree path)."""
+        if not self.valid_data:
+            return
+        k = self.num_tree_per_iteration
+        for vi, cache in self._valid_pred_cache.items():
+            xb = cache["xb"]
+            scores = cache["scores"]
+            for c, ht in enumerate(host_trees):
+                leaf = self._replay_leaves_binned(ht, xb)
+                scores = scores.at[:, c].add(
+                    jnp.asarray(ht.leaf_value.astype(np.float32))[leaf])
+            cache["scores"] = scores
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=())
+    def _replay_leaves_binned_impl(split_leaf, split_feature, threshold_bin,
+                                   default_left, missing_type, is_cat,
+                                   cat_bitset, num_bin, default_bin, xb):
+        from ..core.grow import _bin_go_left
+        n = xb.shape[0]
+        num_nodes = split_leaf.shape[0]
+
+        def step(t, leaf_id):
+            active = split_leaf[t] >= 0
+            col = jnp.take(xb, split_feature[t], axis=1)
+            go_left = _bin_go_left(col, threshold_bin[t], default_left[t],
+                                   missing_type[t], num_bin[t], default_bin[t],
+                                   is_cat[t], cat_bitset[t])
+            in_node = leaf_id == split_leaf[t]
+            return jnp.where(active & in_node & ~go_left, t + 1, leaf_id)
+
+        return jax.lax.fori_loop(0, num_nodes, step,
+                                 jnp.zeros((n,), jnp.int32))
+
+    def _replay_leaves_binned(self, ht: HostTree, xb: jnp.ndarray) -> jnp.ndarray:
+        ds = self.train_data
+        nn = ht.num_nodes
+        inner = np.array([max(ds.inner_feature_index(int(f)), 0)
+                          for f in ht.split_feature], np.int32)
+        num_bin = np.array([ds.bin_mappers[int(f)].num_bin
+                            for f in ht.split_feature], np.int32)
+        default_bin = np.array([ds.bin_mappers[int(f)].default_bin
+                                for f in ht.split_feature], np.int32)
+        return self._replay_leaves_binned_impl(
+            jnp.asarray(ht.split_leaf), jnp.asarray(inner),
+            jnp.asarray(ht.threshold_bin), jnp.asarray(ht.default_left),
+            jnp.asarray(ht.missing_type), jnp.asarray(ht.is_categorical),
+            jnp.asarray(ht.cat_bitset), jnp.asarray(num_bin),
+            jnp.asarray(default_bin), xb)
+
+    # ------------------------------------------------------------ evaluation
+    def get_eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:
+        """Eval metrics for data_idx (0=train, 1..=valid); returns
+        (data_name, metric_name, value, bigger_better) tuples
+        (gbdt.cpp OutputMetric:476-533)."""
+        out = []
+        conv = (self.objective.convert_output if self.objective is not None
+                else None)
+        if data_idx == 0:
+            scores = np.asarray(self.scores)
+            for m in self.train_metrics:
+                vals = m.eval(scores if self.num_tree_per_iteration > 1
+                              else scores[:, 0], conv)
+                for name, v in zip(m.names, vals):
+                    out.append(("training", name, v, m.factor_to_bigger_better > 0))
+        else:
+            vi = data_idx - 1
+            scores = np.asarray(self._valid_pred_cache[vi]["scores"])
+            for m in self.valid_metrics[vi]:
+                vals = m.eval(scores if self.num_tree_per_iteration > 1
+                              else scores[:, 0], conv)
+                for name, v in zip(m.names, vals):
+                    out.append(("valid_%d" % (vi + 1) if vi > 0 else "valid_0",
+                                name, v, m.factor_to_bigger_better > 0))
+        return out
+
+    # ------------------------------------------------------------ prediction
+    def _stacked_predict_trees(self, start: int, end: int) -> tree_mod.PredictTree:
+        trees = self.models[start:end]
+        max_nodes = max((t.num_nodes for t in trees), default=1)
+        max_leaves = max((t.num_leaves for t in trees), default=1)
+        tables = [t.predict_table(max_nodes, max_leaves) for t in trees]
+        return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *tables)
+
+    def predict(self, data: np.ndarray, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False) -> np.ndarray:
+        """Batch prediction on raw feature values (GBDT::Predict,
+        gbdt_prediction.cpp:49-83)."""
+        data = np.asarray(data, np.float32)
+        if data.ndim == 1:
+            data = data.reshape(1, -1)
+        k = self.num_tree_per_iteration
+        total_iters = len(self.models) // k
+        use_iters = total_iters if num_iteration is None or num_iteration <= 0 \
+            else min(num_iteration, total_iters)
+        n = data.shape[0]
+        if use_iters == 0:
+            out = np.zeros((n, k), np.float64)
+        else:
+            x = jnp.asarray(data)
+            outs = []
+            for c in range(k):
+                idxs = [it * k + c for it in range(use_iters)]
+                trees = [self.models[i] for i in idxs]
+                max_nodes = max(t.num_nodes for t in trees)
+                max_leaves = max(t.num_leaves for t in trees)
+                tables = [t.predict_table(max_nodes, max_leaves) for t in trees]
+                stacked = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)),
+                                       *tables)
+                if pred_leaf:
+                    outs.append(np.asarray(
+                        tree_mod.predict_forest_leaves_raw(stacked, x)))
+                else:
+                    outs.append(np.asarray(
+                        tree_mod.predict_forest_raw(stacked, x), np.float64))
+            if pred_leaf:
+                return np.stack(outs, axis=1).reshape(n, -1) if k > 1 else outs[0]
+            out = np.stack(outs, axis=1)
+        if self.average_output and use_iters > 0:
+            out = out / use_iters
+        if not raw_score and self.objective is not None:
+            out = np.asarray(self.objective.convert_output(jnp.asarray(out)))
+        return out[:, 0] if k == 1 else out
+
+    # ------------------------------------------------------------ management
+    def rollback_one_iter(self) -> None:
+        """GBDT::RollbackOneIter (gbdt.cpp:414-430)."""
+        if self.iter_ <= 0:
+            return
+        k = self.num_tree_per_iteration
+        dropped = self.models[-k:]
+        del self.models[-k:]
+        # recompute training scores by subtracting the dropped trees
+        for c, ht in enumerate(dropped):
+            leaf = self._replay_leaves_binned(ht, self.xb)
+            self.scores = self.scores.at[:, c].add(
+                -jnp.asarray(ht.leaf_value.astype(np.float32))[leaf])
+        for vi, cache in self._valid_pred_cache.items():
+            for c, ht in enumerate(dropped):
+                leaf = self._replay_leaves_binned(ht, cache["xb"])
+                cache["scores"] = cache["scores"].at[:, c].add(
+                    -jnp.asarray(ht.leaf_value.astype(np.float32))[leaf])
+        self.iter_ -= 1
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """GBDT::FeatureImportance (gbdt.cpp era)."""
+        num_feat = self.train_data.num_total_features if self.train_data \
+            else (int(max((t.split_feature.max(initial=-1)
+                           for t in self.models), default=-1)) + 1)
+        imp = np.zeros(num_feat, np.float64)
+        k = self.num_tree_per_iteration
+        n_models = (len(self.models) if iteration is None or iteration <= 0
+                    else min(iteration * k, len(self.models)))
+        for t in self.models[:n_models]:
+            for i in range(t.num_nodes):
+                if t.split_leaf[i] >= 0:
+                    if importance_type == "split":
+                        imp[t.split_feature[i]] += 1
+                    else:
+                        imp[t.split_feature[i]] += t.split_gain[i]
+        return imp

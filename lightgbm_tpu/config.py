@@ -1,0 +1,352 @@
+"""Configuration system: LightGBM-compatible parameter names, aliases, defaults.
+
+TPU-native re-design of the reference config (include/LightGBM/config.h:27-855,
+src/io/config.cpp:15-279, src/io/config_auto.cpp). The reference generates its
+setters from docs/Parameters.rst; here a single table of (name, type, default,
+aliases) drives parsing, alias resolution and validation. LightGBM parameter
+names are a de-facto standard, so the Python API accepts any alias the
+reference accepts (config.h:857-865 ParameterAlias::KeyAliasTransform).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+from .log import Log, LightGBMError
+
+# (canonical_name, python_type, default, [aliases])
+# Mirrors config.h params; list type uses comma-separated parsing like the
+# reference's Common::StringToArray.
+_PARAMS: List[Tuple[str, type, Any, List[str]]] = [
+    # ---- core (config.h:100-240) ----
+    ("config", str, "", ["config_file"]),
+    ("task", str, "train", ["task_type"]),
+    ("objective", str, "regression",
+     ["objective_type", "app", "application", "loss"]),
+    ("boosting", str, "gbdt", ["boosting_type", "boost"]),
+    ("data", str, "", ["train", "train_data", "train_data_file", "data_filename"]),
+    ("valid", list, [], ["test", "valid_data", "valid_data_file", "test_data",
+                         "test_data_file", "valid_filenames"]),
+    ("num_iterations", int, 100,
+     ["num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+      "num_rounds", "num_boost_round", "n_estimators", "max_iter"]),
+    ("learning_rate", float, 0.1, ["shrinkage_rate", "eta"]),
+    ("num_leaves", int, 31, ["num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"]),
+    ("tree_learner", str, "serial", ["tree", "tree_type", "tree_learner_type"]),
+    ("num_threads", int, 0,
+     ["num_thread", "nthread", "nthreads", "n_jobs"]),
+    ("device_type", str, "tpu", ["device"]),
+    ("seed", int, 0, ["random_seed", "random_state"]),
+    # ---- learning control (config.h:241-470) ----
+    ("max_depth", int, -1, []),
+    ("min_data_in_leaf", int, 20, ["min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"]),
+    ("min_sum_hessian_in_leaf", float, 1e-3,
+     ["min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight"]),
+    ("bagging_fraction", float, 1.0, ["sub_row", "subsample", "bagging"]),
+    ("bagging_freq", int, 0, ["subsample_freq"]),
+    ("bagging_seed", int, 3, ["bagging_fraction_seed"]),
+    ("feature_fraction", float, 1.0, ["sub_feature", "colsample_bytree"]),
+    ("feature_fraction_seed", int, 2, []),
+    ("early_stopping_round", int, 0,
+     ["early_stopping_rounds", "early_stopping", "n_iter_no_change"]),
+    ("first_metric_only", bool, False, []),
+    ("max_delta_step", float, 0.0, ["max_tree_output", "max_leaf_output"]),
+    ("lambda_l1", float, 0.0, ["reg_alpha", "l1_regularization"]),
+    ("lambda_l2", float, 0.0, ["reg_lambda", "lambda", "l2_regularization"]),
+    ("min_gain_to_split", float, 0.0, ["min_split_gain"]),
+    # DART (config.h:300-340)
+    ("drop_rate", float, 0.1, ["rate_drop"]),
+    ("max_drop", int, 50, []),
+    ("skip_drop", float, 0.5, []),
+    ("xgboost_dart_mode", bool, False, []),
+    ("uniform_drop", bool, False, []),
+    ("drop_seed", int, 4, []),
+    # GOSS
+    ("top_rate", float, 0.2, []),
+    ("other_rate", float, 0.1, []),
+    # categorical
+    ("min_data_per_group", int, 100, []),
+    ("max_cat_threshold", int, 32, []),
+    ("cat_l2", float, 10.0, []),
+    ("cat_smooth", float, 10.0, []),
+    ("max_cat_to_onehot", int, 4, []),
+    # voting parallel (config.h:349)
+    ("top_k", int, 20, ["topk"]),
+    ("monotone_constraints", list, [], ["mc", "monotone_constraint"]),
+    ("feature_contri", list, [], ["feature_contrib", "fc", "fp", "feature_penalty"]),
+    ("forcedsplits_filename", str, "", ["fs", "forced_splits_filename",
+                                        "forced_splits_file", "forced_splits"]),
+    ("refit_decay_rate", float, 0.9, []),
+    ("cegb_tradeoff", float, 1.0, []),
+    ("cegb_penalty_split", float, 0.0, []),
+    ("cegb_penalty_feature_lazy", list, [], []),
+    ("cegb_penalty_feature_coupled", list, [], []),
+    # ---- IO (config.h:400-600) ----
+    ("verbosity", int, 1, ["verbose"]),
+    ("max_bin", int, 255, []),
+    ("min_data_in_bin", int, 3, []),
+    ("bin_construct_sample_cnt", int, 200000, ["subsample_for_bin"]),
+    ("histogram_pool_size", float, -1.0, ["hist_pool_size"]),
+    ("data_random_seed", int, 1, ["data_seed"]),
+    ("output_model", str, "LightGBM_model.txt", ["model_output", "model_out"]),
+    ("snapshot_freq", int, -1, ["save_period"]),
+    ("input_model", str, "", ["model_input", "model_in"]),
+    ("output_result", str, "LightGBM_predict_result.txt",
+     ["predict_result", "prediction_result", "predict_name", "prediction_name",
+      "pred_name", "name_pred"]),
+    ("initscore_filename", str, "", ["init_score_filename", "init_score_file",
+                                     "init_score", "input_init_score"]),
+    ("valid_data_initscores", list, [], ["valid_data_init_scores",
+                                         "valid_init_score_file", "valid_init_score"]),
+    ("pre_partition", bool, False, ["is_pre_partition"]),
+    ("enable_bundle", bool, True, ["is_enable_bundle", "bundle"]),
+    ("max_conflict_rate", float, 0.0, []),
+    ("is_enable_sparse", bool, True, ["is_sparse", "enable_sparse", "sparse"]),
+    ("sparse_threshold", float, 0.8, []),
+    ("use_missing", bool, True, []),
+    ("zero_as_missing", bool, False, []),
+    ("two_round", bool, False, ["two_round_loading", "use_two_round_loading"]),
+    ("save_binary", bool, False, ["is_save_binary", "is_save_binary_file"]),
+    ("header", bool, False, ["has_header"]),
+    ("label_column", str, "", ["label"]),
+    ("weight_column", str, "", ["weight"]),
+    ("group_column", str, "", ["group", "group_id", "query_column", "query", "query_id"]),
+    ("ignore_column", str, "", ["ignore_feature", "blacklist"]),
+    ("categorical_feature", str, "", ["cat_feature", "categorical_column", "cat_column"]),
+    ("predict_raw_score", bool, False, ["is_predict_raw_score", "predict_rawscore", "raw_score"]),
+    ("predict_leaf_index", bool, False, ["is_predict_leaf_index", "leaf_index"]),
+    ("predict_contrib", bool, False, ["is_predict_contrib", "contrib"]),
+    ("num_iteration_predict", int, -1, []),
+    ("pred_early_stop", bool, False, []),
+    ("pred_early_stop_freq", int, 10, []),
+    ("pred_early_stop_margin", float, 10.0, []),
+    ("convert_model_language", str, "", []),
+    ("convert_model", str, "gbdt_prediction.cpp", ["convert_model_file"]),
+    # ---- objective (config.h:600-740) ----
+    ("num_class", int, 1, ["num_classes"]),
+    ("is_unbalance", bool, False, ["unbalance", "unbalanced_sets"]),
+    ("scale_pos_weight", float, 1.0, []),
+    ("sigmoid", float, 1.0, []),
+    ("boost_from_average", bool, True, []),
+    ("reg_sqrt", bool, False, []),
+    ("alpha", float, 0.9, []),
+    ("fair_c", float, 1.0, []),
+    ("poisson_max_delta_step", float, 0.7, []),
+    ("tweedie_variance_power", float, 1.5, []),
+    ("max_position", int, 20, []),
+    ("label_gain", list, [], []),
+    # ---- metric (config.h:700-760) ----
+    ("metric", list, [], ["metrics", "metric_types"]),
+    ("metric_freq", int, 1, ["output_freq"]),
+    ("is_provide_training_metric", bool, False,
+     ["training_metric", "is_training_metric", "train_metric"]),
+    ("eval_at", list, [1, 2, 3, 4, 5],
+     ["ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"]),
+    # ---- network (config.h:740-770) ----
+    ("num_machines", int, 1, ["num_machine"]),
+    ("local_listen_port", int, 12400, ["local_port", "port"]),
+    ("time_out", int, 120, []),
+    ("machine_list_filename", str, "", ["machine_list_file", "machine_list", "mlist"]),
+    ("machines", str, "", ["workers", "nodes"]),
+    # ---- device (config.h:770-790); gpu_* accepted for compat, unused on TPU ----
+    ("gpu_platform_id", int, -1, []),
+    ("gpu_device_id", int, -1, []),
+    ("gpu_use_dp", bool, False, []),
+    # ---- TPU-specific extensions (no reference counterpart) ----
+    ("tpu_hist_dtype", str, "float32", []),   # histogram accumulation dtype
+    ("tpu_donate_buffers", bool, True, []),   # donate score/state buffers under jit
+    ("mesh_shape", list, [], []),             # e.g. [8] / [4,2]; empty = all devices on one axis
+]
+
+_CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}
+_ALIASES: Dict[str, str] = {}
+for _n, _t, _d, _al in _PARAMS:
+    _ALIASES[_n] = _n
+    for _a in _al:
+        _ALIASES[_a] = _n
+
+# Objective aliases (objective_function.cpp:14-42 & config_auto resolution).
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "mean_absolute_percentage_error": "mape", "mape": "mape",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "xentropy": "xentropy", "cross_entropy": "xentropy",
+    "xentlambda": "xentlambda", "cross_entropy_lambda": "xentlambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "lambdarank",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+_BOOSTING_ALIASES = {
+    "gbdt": "gbdt", "gbrt": "gbdt",
+    "dart": "dart",
+    "goss": "goss",
+    "rf": "rf", "random_forest": "rf",
+}
+
+_TREE_LEARNER_ALIASES = {
+    "serial": "serial",
+    "feature": "feature", "feature_parallel": "feature",
+    "data": "data", "data_parallel": "data",
+    "voting": "voting", "voting_parallel": "voting",
+}
+
+
+def _coerce(name: str, typ: type, value: Any) -> Any:
+    try:
+        if typ is bool:
+            if isinstance(value, str):
+                return value.strip().lower() in ("true", "+", "1", "yes")
+            return bool(value)
+        if typ is int:
+            return int(float(value)) if isinstance(value, str) else int(value)
+        if typ is float:
+            return float(value)
+        if typ is list:
+            if isinstance(value, str):
+                value = [v for v in value.replace(" ", ",").split(",") if v != ""]
+            if isinstance(value, (int, float)):
+                value = [value]
+            out = []
+            for v in value:
+                if isinstance(v, str):
+                    try:
+                        v = int(v)
+                    except ValueError:
+                        try:
+                            v = float(v)
+                        except ValueError:
+                            pass
+                out.append(v)
+            return out
+        if typ is str:
+            return str(value)
+    except (TypeError, ValueError) as err:
+        raise LightGBMError("Parameter %s should be of type %s, got %r (%s)"
+                            % (name, typ.__name__, value, err))
+    return value
+
+
+def param_dict_to_str(params: Optional[Dict[str, Any]]) -> str:
+    """Serialize params to the ``k=v`` space-joined string the C API uses."""
+    if not params:
+        return ""
+    pairs = []
+    for k, v in params.items():
+        if isinstance(v, (list, tuple)):
+            pairs.append("%s=%s" % (k, ",".join(map(str, v))))
+        elif v is not None:
+            pairs.append("%s=%s" % (k, v))
+    return " ".join(pairs)
+
+
+def kv2map(args: List[str]) -> Dict[str, str]:
+    """CLI ``key=value`` token parser (config.cpp:15 KV2Map)."""
+    out: Dict[str, str] = {}
+    for token in args:
+        token = token.split("#", 1)[0].strip()
+        if not token:
+            continue
+        if "=" not in token:
+            Log.warning("Unknown parameter %s", token)
+            continue
+        k, v = token.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+class Config:
+    """Typed parameter container (config.h:27 Config struct analog)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        for name, (_typ, default) in _CANON.items():
+            setattr(self, name, copy.copy(default))
+        self.extra_params: Dict[str, Any] = {}
+        if params:
+            self.set(params)
+
+    @staticmethod
+    def resolve_key(key: str) -> str:
+        """ParameterAlias::KeyAliasTransform (config.h:857-865)."""
+        return _ALIASES.get(key, key)
+
+    def set(self, params: Dict[str, Any]) -> "Config":
+        """Config::Set (config.cpp:153): alias resolve, coerce, validate."""
+        resolved: Dict[str, Any] = {}
+        for key, value in params.items():
+            if value is None:
+                continue
+            canon = self.resolve_key(key)
+            if canon in resolved and canon != key:
+                Log.warning("%s is set with both %s and an alias; using %r",
+                            canon, key, resolved[canon])
+                continue
+            resolved[canon] = value
+        for key, value in resolved.items():
+            if key in _CANON:
+                typ, _ = _CANON[key]
+                setattr(self, key, _coerce(key, typ, value))
+            else:
+                self.extra_params[key] = value
+        self._post_process()
+        return self
+
+    def _post_process(self) -> None:
+        obj = str(self.objective).strip().lower()
+        if obj.startswith("quantile_l2"):
+            obj = "quantile"
+        if obj in ("l2_root", "root_mean_squared_error", "rmse"):
+            self.reg_sqrt = True
+        self.objective = _OBJECTIVE_ALIASES.get(obj, obj)
+        self.boosting = _BOOSTING_ALIASES.get(str(self.boosting).strip().lower(),
+                                              self.boosting)
+        self.tree_learner = _TREE_LEARNER_ALIASES.get(
+            str(self.tree_learner).strip().lower(), self.tree_learner)
+        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+            raise LightGBMError("Unknown tree learner type %s" % self.tree_learner)
+        if self.boosting not in ("gbdt", "dart", "goss", "rf"):
+            raise LightGBMError("Unknown boosting type %s" % self.boosting)
+        # derived: is_parallel (config.h:790)
+        self.is_parallel = (self.tree_learner != "serial") or self.num_machines > 1
+        if self.boosting == "rf":
+            if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
+                raise LightGBMError(
+                    "Random forest needs bagging_freq > 0 and bagging_fraction in (0, 1)")
+        if self.boosting == "goss":
+            if self.top_rate + self.other_rate > 1.0:
+                raise LightGBMError("GOSS needs top_rate + other_rate <= 1.0")
+        if not (0.0 < self.feature_fraction <= 1.0):
+            raise LightGBMError("feature_fraction should be in (0, 1.0]")
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            raise LightGBMError("bagging_fraction should be in (0, 1.0]")
+        if not (1 < self.max_bin <= 256):
+            raise LightGBMError("max_bin should be in (1, 256]")
+        if self.num_leaves < 2:
+            raise LightGBMError("num_leaves should be >= 2")
+        if self.verbosity >= 0:
+            Log.reset_level(self.verbosity)
+
+    def copy(self) -> "Config":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {name: getattr(self, name) for name in _CANON}
+        d.update(self.extra_params)
+        return d
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "Config(%r)" % (self.to_dict(),)
+
+
+def load_config_file(path: str) -> Dict[str, str]:
+    """Parse a ``key=value`` config file with # comments (application.cpp:48-81)."""
+    with open(path, "r") as fh:
+        return kv2map(fh.read().splitlines())

@@ -1,0 +1,329 @@
+"""Leaf-wise (best-first) tree growth as a single jit-compiled loop.
+
+TPU-native re-design of SerialTreeLearner::Train
+(src/treelearner/serial_tree_learner.cpp:169-233) and Tree::Split
+(include/LightGBM/tree.h:393, src/io/tree.cpp:49-67). Differences by design:
+
+- The reference breaks out of the split loop when the best gain <= 0
+  (serial_tree_learner.cpp:217-219); under jit the loop runs a fixed
+  ``num_leaves - 1`` iterations with *masked no-op* splits instead.
+- DataPartition's index-shuffling (data_partition.hpp:20-37) becomes a per-row
+  ``leaf_id`` vector; partitioning a leaf is a masked elementwise update, and
+  the final ``leaf_id`` doubles as the score-update fast path
+  (score_updater.hpp:53-117).
+- The histogram-subtraction trick is kept: only the smaller child's histogram
+  is built (serial_tree_learner.cpp:383-397, 547-548); the sibling is
+  parent - child. Histograms for dead iterations are skipped via lax.cond.
+- Node numbering matches the reference exactly: splitting leaf ``l`` at step
+  ``t`` creates internal node ``t``; the left child keeps leaf index ``l``,
+  the right child becomes leaf ``t + 1`` (tree.cpp:49-67). Child pointers use
+  the ``~leaf`` encoding (negative = leaf).
+- Data-parallel training (data_parallel_tree_learner.cpp:146-245) falls out
+  of the same code: when ``axis_name`` is set, histograms and root sums are
+  psum-reduced over the mesh axis — the ReduceScatter+best-split-sync dance
+  collapses into XLA collectives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import build_histogram
+from .split import (BestSplit, FeatureMeta, SplitParams, K_MIN_SCORE,
+                    MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                    calculate_leaf_output, find_best_split_numerical)
+
+
+class GrowParams(NamedTuple):
+    """Static growth hyper-parameters (hashable; part of the jit key)."""
+    num_leaves: int
+    num_bins: int           # padded bin axis size B
+    max_depth: int
+    split: SplitParams
+    row_chunk: int = 16384
+    hist_impl: str = "matmul"
+
+
+class TreeArrays(NamedTuple):
+    """Fixed-capacity SoA tree, mirroring Tree's layout (tree.h:404-517).
+
+    Internal-node arrays have length ``num_leaves - 1``; leaf arrays
+    ``num_leaves``. ``split_leaf[t]`` records which leaf node ``t`` split —
+    that is what makes sequential partition replay (and thus vectorized
+    prediction) possible without pointer chasing.
+    """
+    split_feature: jnp.ndarray    # [L-1] int32 (inner feature index)
+    threshold_bin: jnp.ndarray    # [L-1] int32
+    default_left: jnp.ndarray     # [L-1] bool
+    missing_type: jnp.ndarray     # [L-1] int32
+    is_categorical: jnp.ndarray   # [L-1] bool
+    cat_bitset: jnp.ndarray       # [L-1, 8] uint32 (bins going left)
+    left_child: jnp.ndarray       # [L-1] int32 (~leaf encoding for leaves)
+    right_child: jnp.ndarray      # [L-1] int32
+    split_gain: jnp.ndarray       # [L-1] f32
+    internal_value: jnp.ndarray   # [L-1] f32 (node output)
+    internal_weight: jnp.ndarray  # [L-1] f32 (sum_hess)
+    internal_count: jnp.ndarray   # [L-1] f32
+    split_leaf: jnp.ndarray       # [L-1] int32
+    leaf_value: jnp.ndarray       # [L] f32
+    leaf_weight: jnp.ndarray      # [L] f32 (sum_hess)
+    leaf_count: jnp.ndarray       # [L] f32
+    leaf_parent: jnp.ndarray      # [L] int32 (node index, -1 = root)
+    leaf_depth: jnp.ndarray       # [L] int32
+    num_leaves: jnp.ndarray       # scalar int32
+
+    @property
+    def max_leaves(self) -> int:
+        return self.leaf_value.shape[0]
+
+
+def empty_tree(num_leaves: int) -> TreeArrays:
+    l = num_leaves
+    return TreeArrays(
+        split_feature=jnp.zeros((l - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((l - 1,), jnp.int32),
+        default_left=jnp.zeros((l - 1,), bool),
+        missing_type=jnp.zeros((l - 1,), jnp.int32),
+        is_categorical=jnp.zeros((l - 1,), bool),
+        cat_bitset=jnp.zeros((l - 1, 8), jnp.uint32),
+        left_child=jnp.full((l - 1,), -1, jnp.int32),
+        right_child=jnp.full((l - 1,), -1, jnp.int32),
+        split_gain=jnp.zeros((l - 1,), jnp.float32),
+        internal_value=jnp.zeros((l - 1,), jnp.float32),
+        internal_weight=jnp.zeros((l - 1,), jnp.float32),
+        internal_count=jnp.zeros((l - 1,), jnp.float32),
+        split_leaf=jnp.full((l - 1,), -1, jnp.int32),
+        leaf_value=jnp.zeros((l,), jnp.float32),
+        leaf_weight=jnp.zeros((l,), jnp.float32),
+        leaf_count=jnp.zeros((l,), jnp.float32),
+        leaf_parent=jnp.full((l,), -1, jnp.int32),
+        leaf_depth=jnp.zeros((l,), jnp.int32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+
+
+class _GrowState(NamedTuple):
+    leaf_id: jnp.ndarray      # [N] int32
+    hist_pool: jnp.ndarray    # [L, F, B, 3] f32 per-leaf histograms
+    best: BestSplit           # per-leaf best split, fields [L]
+    tree: TreeArrays
+
+
+def _empty_best(num_leaves: int) -> BestSplit:
+    l = num_leaves
+    f32 = lambda: jnp.zeros((l,), jnp.float32)
+    return BestSplit(
+        gain=jnp.full((l,), K_MIN_SCORE, jnp.float32),
+        feature=jnp.zeros((l,), jnp.int32),
+        threshold=jnp.zeros((l,), jnp.int32),
+        default_left=jnp.zeros((l,), bool),
+        left_sum_grad=f32(), left_sum_hess=f32(), left_count=f32(),
+        right_sum_grad=f32(), right_sum_hess=f32(), right_count=f32(),
+        left_output=f32(), right_output=f32(),
+        is_categorical=jnp.zeros((l,), bool),
+        cat_bitset=jnp.zeros((l, 8), jnp.uint32),
+    )
+
+
+def _masked_set(arr: jnp.ndarray, idx: jnp.ndarray, val, valid) -> jnp.ndarray:
+    return arr.at[idx].set(jnp.where(valid, val, arr[idx]))
+
+
+def _bin_go_left(col: jnp.ndarray, threshold: jnp.ndarray,
+                 default_left: jnp.ndarray, missing_type: jnp.ndarray,
+                 num_bin: jnp.ndarray, default_bin: jnp.ndarray,
+                 is_cat: jnp.ndarray, cat_bitset: jnp.ndarray) -> jnp.ndarray:
+    """Decision in bin space (Tree::NumericalDecisionInner /
+    CategoricalDecisionInner, tree.h:212-260)."""
+    coli = col.astype(jnp.int32)
+    is_missing = jnp.where(
+        missing_type == MISSING_NAN, coli == num_bin - 1,
+        jnp.where(missing_type == MISSING_ZERO, coli == default_bin, False))
+    numerical = jnp.where(is_missing, default_left, coli <= threshold)
+    word = cat_bitset[coli >> 5]
+    categorical = ((word >> (coli & 31).astype(jnp.uint32)) & 1) == 1
+    return jnp.where(is_cat, categorical, numerical)
+
+
+def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+              sample_mask: jnp.ndarray, meta: FeatureMeta,
+              feature_mask: jnp.ndarray, params: GrowParams,
+              axis_name: Optional[str] = None
+              ) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one leaf-wise tree; returns (tree, final per-row leaf_id).
+
+    xb [N, F] uint8 binned features; grad/hess [N] f32 (objective-weighted);
+    sample_mask [N] f32 bagging inclusion. With ``axis_name`` set, rows are
+    assumed sharded over that mesh axis and histograms/root sums are
+    psum-reduced (the data-parallel learner's ReduceScatter analog).
+    """
+    n, f = xb.shape
+    l = params.num_leaves
+    b = params.num_bins
+    sp = params.split
+
+    def psum(x):
+        return lax.psum(x, axis_name) if axis_name is not None else x
+
+    def hist_for_mask(mask_f32):
+        h = build_histogram(xb, grad, hess, mask_f32, num_bins=b,
+                            row_chunk=params.row_chunk, impl=params.hist_impl)
+        return psum(h)
+
+    def best_for(hist, sum_g, sum_h, cnt, depth_ok):
+        bs = find_best_split_numerical(hist, meta, sp, sum_g, sum_h, cnt,
+                                       feature_mask)
+        return bs._replace(gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
+
+    # ---- root ------------------------------------------------------------
+    sample_mask = sample_mask.astype(jnp.float32)
+    root_g = psum(jnp.sum(grad * sample_mask))
+    root_h = psum(jnp.sum(hess * sample_mask))
+    root_c = psum(jnp.sum(sample_mask))
+    hist_root = hist_for_mask(sample_mask)
+
+    tree = empty_tree(l)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(
+            calculate_leaf_output(root_g, root_h, sp.lambda_l1, sp.lambda_l2,
+                                  sp.max_delta_step)),
+        leaf_weight=tree.leaf_weight.at[0].set(root_h),
+        leaf_count=tree.leaf_count.at[0].set(root_c))
+
+    best0 = best_for(hist_root, root_g, root_h, root_c, True)  # root: depth 0
+    best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
+
+    hist_pool = jnp.zeros((l, f, b, 3), jnp.float32)
+    hist_pool = hist_pool.at[0].set(hist_root)
+
+    state = _GrowState(
+        leaf_id=jnp.zeros((n,), jnp.int32),
+        hist_pool=hist_pool, best=best, tree=tree)
+
+    def step(t: jnp.ndarray, s: _GrowState) -> _GrowState:
+        tree = s.tree
+        leaf = jnp.argmax(s.best.gain).astype(jnp.int32)
+        cur = jax.tree.map(lambda a: a[leaf], s.best)
+        valid = cur.gain > 0.0  # reference breaks on gain <= 0 (:217-219)
+
+        # ---- partition rows of `leaf` (DataPartition::Split analog) ------
+        col = jnp.take(xb, cur.feature, axis=1)
+        go_left = _bin_go_left(
+            col, cur.threshold, cur.default_left,
+            meta.missing_type[cur.feature], meta.num_bin[cur.feature],
+            meta.default_bin[cur.feature], cur.is_categorical, cur.cat_bitset)
+        in_leaf = s.leaf_id == leaf
+        right_leaf = t + 1
+        leaf_id = jnp.where(valid & in_leaf & ~go_left, right_leaf, s.leaf_id)
+
+        # ---- tree bookkeeping (Tree::Split, tree.cpp:49-67) --------------
+        node = t
+        parent_node = tree.leaf_parent[leaf]
+        safe_p = jnp.maximum(parent_node, 0)
+        p_exists = valid & (parent_node >= 0)
+        was_left = tree.left_child[safe_p] == ~leaf
+        left_child = _masked_set(tree.left_child, safe_p, node,
+                                 p_exists & was_left)
+        right_child = _masked_set(tree.right_child, safe_p, node,
+                                  p_exists & ~was_left)
+        left_child = _masked_set(left_child, node, ~leaf, valid)
+        right_child = _masked_set(right_child, node, ~right_leaf, valid)
+
+        depth = tree.leaf_depth[leaf] + 1
+        parent_value = calculate_leaf_output(
+            cur.left_sum_grad + cur.right_sum_grad,
+            cur.left_sum_hess + cur.right_sum_hess,
+            sp.lambda_l1, sp.lambda_l2, sp.max_delta_step)
+
+        tree = tree._replace(
+            split_feature=_masked_set(tree.split_feature, node, cur.feature, valid),
+            threshold_bin=_masked_set(tree.threshold_bin, node, cur.threshold, valid),
+            default_left=_masked_set(tree.default_left, node, cur.default_left, valid),
+            missing_type=_masked_set(tree.missing_type, node,
+                                     meta.missing_type[cur.feature], valid),
+            is_categorical=_masked_set(tree.is_categorical, node,
+                                       cur.is_categorical, valid),
+            cat_bitset=tree.cat_bitset.at[node].set(
+                jnp.where(valid, cur.cat_bitset, tree.cat_bitset[node])),
+            left_child=left_child, right_child=right_child,
+            split_gain=_masked_set(tree.split_gain, node, cur.gain, valid),
+            internal_value=_masked_set(tree.internal_value, node, parent_value, valid),
+            internal_weight=_masked_set(tree.internal_weight, node,
+                                        cur.left_sum_hess + cur.right_sum_hess, valid),
+            internal_count=_masked_set(tree.internal_count, node,
+                                       cur.left_count + cur.right_count, valid),
+            split_leaf=_masked_set(tree.split_leaf, node, leaf, valid),
+            leaf_value=_masked_set(
+                _masked_set(tree.leaf_value, leaf, cur.left_output, valid),
+                right_leaf, cur.right_output, valid),
+            leaf_weight=_masked_set(
+                _masked_set(tree.leaf_weight, leaf, cur.left_sum_hess, valid),
+                right_leaf, cur.right_sum_hess, valid),
+            leaf_count=_masked_set(
+                _masked_set(tree.leaf_count, leaf, cur.left_count, valid),
+                right_leaf, cur.right_count, valid),
+            leaf_parent=_masked_set(
+                _masked_set(tree.leaf_parent, leaf, node, valid),
+                right_leaf, node, valid),
+            leaf_depth=_masked_set(
+                _masked_set(tree.leaf_depth, leaf, depth, valid),
+                right_leaf, depth, valid),
+            num_leaves=tree.num_leaves + valid.astype(jnp.int32))
+
+        # ---- histograms: build smaller child, subtract for sibling -------
+        left_smaller = cur.left_count <= cur.right_count
+        small_leaf = jnp.where(left_smaller, leaf, right_leaf)
+        large_leaf = jnp.where(left_smaller, right_leaf, leaf)
+
+        def live_hist(_):
+            m = (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
+            return hist_for_mask(m)
+
+        if axis_name is None:
+            # skip dead iterations entirely (tree stopped growing early)
+            hist_small = lax.cond(valid, live_hist,
+                                  lambda _: jnp.zeros((f, b, 3), jnp.float32),
+                                  operand=None)
+        else:
+            # collectives can't sit under a cond branch in SPMD code; a dead
+            # iteration just psums zeros
+            hist_small = hist_for_mask(
+                (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
+                * valid.astype(jnp.float32))
+        hist_large = s.hist_pool[leaf] - hist_small
+        hist_pool = s.hist_pool.at[small_leaf].set(
+            jnp.where(valid, hist_small, s.hist_pool[small_leaf]))
+        hist_pool = hist_pool.at[large_leaf].set(
+            jnp.where(valid, hist_large, hist_pool[large_leaf]))
+
+        # ---- best splits for the two children ----------------------------
+        depth_ok = (params.max_depth <= 0) | (depth < params.max_depth)
+        hist_left = jnp.where(left_smaller, hist_small, hist_large)
+        hist_right = jnp.where(left_smaller, hist_large, hist_small)
+
+        def child_bests(_):
+            bl = best_for(hist_left, cur.left_sum_grad, cur.left_sum_hess,
+                          cur.left_count, depth_ok)
+            br = best_for(hist_right, cur.right_sum_grad, cur.right_sum_hess,
+                          cur.right_count, depth_ok)
+            return bl, br
+
+        def dead_bests(_):
+            dead = jax.tree.map(lambda a: a[0], _empty_best(1))
+            return dead, dead
+
+        bl, br = lax.cond(valid, child_bests, dead_bests, operand=None)
+        best = jax.tree.map(
+            lambda arr, vl, vr: _masked_set(_masked_set(arr, leaf, vl, valid),
+                                            right_leaf, vr, valid),
+            s.best, bl, br)
+
+        return _GrowState(leaf_id=leaf_id, hist_pool=hist_pool,
+                          best=best, tree=tree)
+
+    state = lax.fori_loop(0, l - 1, step, state)
+    return state.tree, state.leaf_id

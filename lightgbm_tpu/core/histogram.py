@@ -1,0 +1,120 @@
+"""Histogram construction — the hottest op in GBDT training.
+
+TPU-native re-design of the reference histogram kernels (dense_bin.hpp:66-130
+ConstructHistogram, the OpenCL kernels ocl/histogram{16,64,256}.cl, and
+Dataset::ConstructHistograms, src/io/dataset.cpp). Instead of per-thread /
+per-workgroup scatter with atomics, bins are accumulated as a one-hot matmul
+so the contraction runs on the MXU:
+
+    hist[f, b, k] = sum_n onehot(X[n, f] == b) * vals[n, k]
+
+chunked over rows with ``lax.scan`` so the transient one-hot tile stays small.
+A scatter-add (segment-sum) variant is kept for CPU meshes where XLA scatter
+is fast. Accumulation is float32, like the GPU learner's single-precision
+histograms (gpu_tree_learner.h:74-78) — validated to the same AUC tolerance.
+
+The entry ``build_histogram`` returns ``[F, B, 3]`` with channels
+(sum_grad, sum_hess, count), the HistogramBinEntry layout (bin.h:29-57) as a
+structure-of-arrays stack.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _hist_chunk_matmul(xb_chunk: jnp.ndarray, vals_chunk: jnp.ndarray,
+                       num_bins: int) -> jnp.ndarray:
+    """One row-chunk via one-hot contraction on the MXU.
+
+    xb_chunk: [C, F] uint8/int32; vals_chunk: [C, 3] f32 -> [F, B, 3] f32.
+    """
+    c, f = xb_chunk.shape
+    onehot = (xb_chunk[:, :, None] == jnp.arange(num_bins, dtype=xb_chunk.dtype)
+              ).astype(vals_chunk.dtype)  # [C, F, B]
+    # contract over rows: [F*B, C] @ [C, 3]
+    return lax.dot_general(onehot, vals_chunk,
+                           (((0,), (0,)), ((), ()))
+                           )  # [F, B, 3]
+
+
+def _hist_scatter(xb: jnp.ndarray, vals: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Scatter-add variant: good on CPU, used for small row counts."""
+    n, f = xb.shape
+    flat = xb.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    hist = jnp.zeros((f * num_bins, vals.shape[-1]), dtype=vals.dtype)
+    hist = hist.at[flat.reshape(-1)].add(
+        jnp.broadcast_to(vals[:, None, :], (n, f, vals.shape[-1])
+                         ).reshape(n * f, vals.shape[-1]))
+    return hist.reshape(f, num_bins, vals.shape[-1])
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk", "impl"))
+def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                    mask: jnp.ndarray, num_bins: int,
+                    row_chunk: int = 16384, impl: str = "matmul") -> jnp.ndarray:
+    """Build (grad, hess, count) histograms for every feature.
+
+    Args:
+      xb: [N, F] binned features (uint8).
+      grad, hess: [N] f32 gradients/hessians (already weighted by objective).
+      mask: [N] f32 row inclusion (leaf membership x bagging); 0 excludes.
+      num_bins: static total bin count B (max over features).
+      row_chunk: rows per scan step (bounds transient one-hot memory).
+      impl: "matmul" (MXU one-hot) or "scatter" (XLA scatter-add).
+
+    Returns: [F, B, 3] f32.
+    """
+    n, f = xb.shape
+    vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # [N, 3]
+    if impl == "scatter" or n <= row_chunk:
+        if impl == "scatter":
+            return _hist_scatter(xb, vals, num_bins)
+        return _hist_chunk_matmul(xb, vals, num_bins)
+
+    num_chunks = (n + row_chunk - 1) // row_chunk
+    pad = num_chunks * row_chunk - n
+    if pad:
+        xb = jnp.pad(xb, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))  # padded rows have mask 0
+    xb_c = xb.reshape(num_chunks, row_chunk, f)
+    vals_c = vals.reshape(num_chunks, row_chunk, 3)
+
+    def step(acc, chunk):
+        xbc, vc = chunk
+        return acc + _hist_chunk_matmul(xbc, vc, num_bins), None
+
+    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
+    hist, _ = lax.scan(step, init, (xb_c, vals_c))
+    return hist
+
+
+def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
+    """Histogram subtraction trick: sibling = parent - child
+    (FeatureHistogram::Subtract, feature_histogram.hpp:67-75)."""
+    return parent - child
+
+
+def fix_histogram(hist: jnp.ndarray, default_bins: jnp.ndarray,
+                  sum_grad: jnp.ndarray, sum_hess: jnp.ndarray,
+                  count: jnp.ndarray) -> jnp.ndarray:
+    """Reconstruct a skipped default bin from leaf totals
+    (Dataset::FixHistogram, dataset.h:411-412).
+
+    Our kernels always accumulate every bin, so this is only used to repair
+    float32 drift on the default bin after repeated subtraction: the default
+    bin is recomputed so per-feature totals equal the (exact) leaf totals.
+
+    hist: [F, B, 3]; default_bins: [F] int32; sums: scalars.
+    """
+    f, b, _ = hist.shape
+    arange_b = jnp.arange(b)[None, :]
+    is_default = arange_b == default_bins[:, None]  # [F, B]
+    totals = jnp.stack([sum_grad, sum_hess, count])  # [3]
+    sum_wo_default = jnp.sum(jnp.where(is_default[..., None], 0.0, hist), axis=1)
+    fixed = totals[None, :] - sum_wo_default  # [F, 3]
+    return jnp.where(is_default[..., None], fixed[:, None, :], hist)

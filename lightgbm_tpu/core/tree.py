@@ -1,0 +1,106 @@
+"""Tree prediction on device.
+
+TPU-native re-design of Tree::Predict / GetLeaf (include/LightGBM/tree.h:203-260,
+src/boosting/gbdt_prediction.cpp:9-83). Instead of per-row pointer-chasing
+node traversal, prediction replays splits in creation order: node ``t`` split
+leaf ``split_leaf[t]``, so processing nodes 0..L-2 sequentially moves each row
+through exactly the decisions it would make in a traversal — every step is one
+vectorized compare over all rows. This mirrors how training's DataPartition
+evolves, and maps to the TPU as L-1 fused elementwise passes.
+
+Raw-value prediction uses real thresholds (converted from bin thresholds at
+model-extraction time, like Tree::Split storing ``threshold_`` alongside
+``threshold_in_bin_``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .split import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_ZERO_THRESHOLD = 1e-35
+
+
+class PredictTree(NamedTuple):
+    """Per-tree arrays needed for replay prediction; stack along axis 0 for a
+    whole model ([T, L-1] / [T, L])."""
+    split_leaf: jnp.ndarray      # [L-1] int32; -1 = unused node
+    split_feature: jnp.ndarray   # [L-1] int32 (real feature index for raw)
+    threshold: jnp.ndarray       # [L-1] f32 real threshold (raw predict)
+    threshold_bin: jnp.ndarray   # [L-1] int32 (binned predict)
+    default_left: jnp.ndarray    # [L-1] bool
+    missing_type: jnp.ndarray    # [L-1] int32
+    is_categorical: jnp.ndarray  # [L-1] bool
+    cat_bitset: jnp.ndarray      # [L-1, 8] uint32
+    leaf_value: jnp.ndarray      # [L] f32
+
+
+def _raw_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
+                 default_left: jnp.ndarray, missing_type: jnp.ndarray,
+                 is_cat: jnp.ndarray, cat_bitset: jnp.ndarray) -> jnp.ndarray:
+    """Tree::NumericalDecision / CategoricalDecision on raw values
+    (tree.h:212-243)."""
+    is_nan = jnp.isnan(fval)
+    # NaN with non-NaN missing handling is treated as 0 (tree.h NumericalDecision)
+    fval_safe = jnp.where(is_nan, 0.0, fval)
+    is_zero = jnp.abs(fval_safe) <= K_ZERO_THRESHOLD
+    use_default = jnp.where(
+        missing_type == MISSING_NAN, is_nan,
+        jnp.where(missing_type == MISSING_ZERO, is_zero | is_nan, False))
+    numerical = jnp.where(use_default, default_left, fval_safe <= threshold)
+    cat_i = jnp.clip(fval_safe, 0, 255).astype(jnp.int32)
+    word = cat_bitset[cat_i >> 5]
+    cat_ok = (~is_nan) & (fval >= 0) & (fval < 256)
+    categorical = cat_ok & (((word >> (cat_i & 31).astype(jnp.uint32)) & 1) == 1)
+    return jnp.where(is_cat, categorical, numerical)
+
+
+def predict_tree_leaves_raw(tree: PredictTree, x: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per row for raw [N, F] float input (Tree::GetLeaf analog)."""
+    n = x.shape[0]
+    num_nodes = tree.split_leaf.shape[0]
+
+    def step(t, leaf_id):
+        active = tree.split_leaf[t] >= 0
+        fval = jnp.take(x, tree.split_feature[t], axis=1)
+        go_left = _raw_go_left(fval, tree.threshold[t], tree.default_left[t],
+                               tree.missing_type[t], tree.is_categorical[t],
+                               tree.cat_bitset[t])
+        in_node = leaf_id == tree.split_leaf[t]
+        return jnp.where(active & in_node & ~go_left, t + 1, leaf_id)
+
+    return lax.fori_loop(0, num_nodes, step, jnp.zeros((n,), jnp.int32))
+
+
+def predict_tree_raw(tree: PredictTree, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-row tree output for raw input."""
+    return tree.leaf_value[predict_tree_leaves_raw(tree, x)]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def predict_forest_raw(trees: PredictTree, x: jnp.ndarray) -> jnp.ndarray:
+    """Sum of all tree outputs; ``trees`` fields stacked [T, ...].
+
+    Returns [N] raw scores (single output model). Multiclass callers vmap or
+    reshape the tree axis.
+    """
+    def body(acc, tree):
+        return acc + predict_tree_raw(tree, x), None
+
+    init = jnp.zeros((x.shape[0],), jnp.float32)
+    out, _ = lax.scan(body, init, trees)
+    return out
+
+
+def predict_forest_leaves_raw(trees: PredictTree, x: jnp.ndarray) -> jnp.ndarray:
+    """[N, T] leaf indices (PredictLeafIndex analog, gbdt.cpp:564-583)."""
+    def body(_, tree):
+        return 0, predict_tree_leaves_raw(tree, x)
+
+    _, leaves = lax.scan(body, 0, trees)
+    return leaves.T

@@ -1,0 +1,271 @@
+"""Binned Dataset + Metadata.
+
+TPU-native re-design of the reference Dataset/Metadata/DatasetLoader
+(include/LightGBM/dataset.h:36-627, src/io/dataset.cpp, src/io/metadata.cpp,
+src/io/dataset_loader.cpp). Differences by design:
+
+- Storage is a single dense ``[num_data, num_features] uint8`` bin matrix —
+  the TPU histogram kernels want one contiguous HBM operand, not per-group
+  Bin objects (dense_bin.hpp / sparse_bin.hpp). Sparse inputs are densified
+  at bin time; ``max_bin <= 256`` keeps it one byte per value.
+- EFB-style trivial-feature dropping happens here (used_feature mapping like
+  dataset.h:613-618); full exclusive-feature bundling operates on the binned
+  matrix as a host-side column merge.
+- The "bin once, train many" artifact (dataset_loader.cpp:266 LoadFromBinFile)
+  is an ``.npz`` cache of the bin matrix + mappers + metadata.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..log import Log, LightGBMError, check
+from .binning import BinMapper, BinType, MissingType
+
+
+class Metadata:
+    """Labels / weights / query boundaries / init scores (dataset.h:36-245)."""
+
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1] int
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        arr = np.ascontiguousarray(label, dtype=np.float32).reshape(-1)
+        check(len(arr) == self.num_data or self.num_data == 0,
+              "Length of label is not same with #data")
+        self.label = arr
+        self.num_data = len(arr)
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        arr = np.ascontiguousarray(weight, dtype=np.float32).reshape(-1)
+        check(len(arr) == self.num_data, "Length of weight is not same with #data")
+        self.weight = arr
+
+    def set_query(self, group: Optional[Sequence[int]]) -> None:
+        """Accepts per-query sizes (LightGBM group format) -> boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        arr = np.ascontiguousarray(group, dtype=np.int64).reshape(-1)
+        boundaries = np.concatenate([[0], np.cumsum(arr)])
+        check(boundaries[-1] == self.num_data,
+              "Sum of query counts is not same with #data")
+        self.query_boundaries = boundaries.astype(np.int32)
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        arr = np.ascontiguousarray(init_score, dtype=np.float64).reshape(-1)
+        self.init_score = arr
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+def _parse_categorical(categorical_feature, feature_names: List[str]) -> List[int]:
+    out: List[int] = []
+    if not categorical_feature:
+        return out
+    if isinstance(categorical_feature, str):
+        categorical_feature = [c for c in categorical_feature.split(",") if c]
+    for c in categorical_feature:
+        if isinstance(c, str) and not c.lstrip("-").isdigit():
+            if c in feature_names:
+                out.append(feature_names.index(c))
+            else:
+                raise LightGBMError("Unknown categorical feature name %s" % c)
+        else:
+            out.append(int(c))
+    return sorted(set(out))
+
+
+class BinnedDataset:
+    """The core training artifact: bin matrix + mappers + metadata.
+
+    This is the analog of the reference ``Dataset`` (dataset.h:278-627); the
+    user-facing lazy ``Dataset`` wrapper lives in ``lightgbm_tpu.basic``.
+    """
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bin_mappers: List[BinMapper] = []          # per original feature
+        self.used_features: List[int] = []              # original idx of stored cols
+        self.X_binned: Optional[np.ndarray] = None      # [num_data, num_used] uint8
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.max_bin: int = 255
+        self._device_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------ construct
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, config: Config,
+                    label: Optional[Sequence[float]] = None,
+                    weight: Optional[Sequence[float]] = None,
+                    group: Optional[Sequence[int]] = None,
+                    init_score: Optional[Sequence[float]] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_feature: Optional[Union[str, List]] = None,
+                    reference: Optional["BinnedDataset"] = None) -> "BinnedDataset":
+        """Bin a raw [N, F] float matrix (DatasetLoader::CostructFromSampleData
+        analog, dataset_loader.cpp:700-820)."""
+        data = np.asarray(data)
+        if data.ndim != 2:
+            raise LightGBMError("Data should be 2-D, got shape %s" % (data.shape,))
+        n, f = data.shape
+        self = cls()
+        self.num_data = n
+        self.num_total_features = f
+        self.max_bin = config.max_bin
+        self.feature_names = feature_names or ["Column_%d" % i for i in range(f)]
+
+        if reference is not None:
+            # validation set: reuse the reference's bin mappers / layout
+            check(f == reference.num_total_features,
+                  "The number of features in data (%d) is not the same as it was "
+                  "in training data (%d)" % (f, reference.num_total_features))
+            self.bin_mappers = reference.bin_mappers
+            self.used_features = reference.used_features
+            self.feature_names = reference.feature_names
+        else:
+            cat_idx = set(_parse_categorical(
+                categorical_feature if categorical_feature is not None
+                else config.categorical_feature, self.feature_names))
+            self.bin_mappers = []
+            sample_cnt = min(n, config.bin_construct_sample_cnt)
+            if sample_cnt < n:
+                rng = np.random.RandomState(config.data_random_seed)
+                sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+            else:
+                sample_idx = slice(None)
+            data64 = np.asarray(data, dtype=np.float64)
+            for j in range(f):
+                col = data64[:, j][sample_idx]
+                mapper = BinMapper()
+                # the reference sampler stores only non-zero values; replicate
+                # (NaNs fail both comparisons and are kept)
+                nz = col[~((col >= -1e-35) & (col <= 1e-35))]
+                mapper.find_bin(
+                    nz, total_sample_cnt=len(col), max_bin=config.max_bin,
+                    min_data_in_bin=config.min_data_in_bin,
+                    min_split_data=config.min_data_in_leaf,
+                    bin_type=BinType.CATEGORICAL if j in cat_idx else BinType.NUMERICAL,
+                    use_missing=config.use_missing,
+                    zero_as_missing=config.zero_as_missing)
+                self.bin_mappers.append(mapper)
+            self.used_features = [j for j in range(f)
+                                  if not self.bin_mappers[j].is_trivial]
+            if not self.used_features:
+                Log.warning("There are no meaningful features, as all feature "
+                            "values are constant.")
+
+        cols = []
+        data64 = np.asarray(data, dtype=np.float64)
+        for j in self.used_features:
+            cols.append(self.bin_mappers[j].values_to_bins(data64[:, j]).astype(np.uint8))
+        self.X_binned = (np.stack(cols, axis=1) if cols
+                         else np.zeros((n, 0), dtype=np.uint8))
+
+        self.metadata = Metadata(n)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_query(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    # ------------------------------------------------------------ accessors
+    @property
+    def num_features(self) -> int:
+        """Number of stored (non-trivial) features."""
+        return len(self.used_features)
+
+    def feature_num_bin(self, used_idx: int) -> int:
+        return self.bin_mappers[self.used_features[used_idx]].num_bin
+
+    def real_feature_index(self, used_idx: int) -> int:
+        """Inner (stored) -> original feature index (dataset.h:613)."""
+        return self.used_features[used_idx]
+
+    def inner_feature_index(self, real_idx: int) -> int:
+        try:
+            return self.used_features.index(real_idx)
+        except ValueError:
+            return -1
+
+    def max_num_bin(self) -> int:
+        return max((self.feature_num_bin(i) for i in range(self.num_features)),
+                   default=1)
+
+    def get_feature_infos(self) -> List[str]:
+        """Model-file ``feature_infos`` strings ([min:max] / categorical list)."""
+        infos = []
+        for j in range(self.num_total_features):
+            m = self.bin_mappers[j] if j < len(self.bin_mappers) else None
+            if m is None or m.is_trivial:
+                infos.append("none")
+            elif m.bin_type == BinType.CATEGORICAL:
+                infos.append(":".join(str(c) for c in sorted(m.bin_2_categorical)))
+            else:
+                infos.append("[%s:%s]" % (repr(m.min_val), repr(m.max_val)))
+        return infos
+
+    # ------------------------------------------------------------ binary cache
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (dataset.h:394 SaveBinaryFile analog)."""
+        meta = {
+            "num_data": self.num_data,
+            "num_total_features": self.num_total_features,
+            "used_features": self.used_features,
+            "feature_names": self.feature_names,
+            "max_bin": self.max_bin,
+            "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+        }
+        arrays: Dict[str, np.ndarray] = {"X_binned": self.X_binned}
+        if self.metadata.label is not None:
+            arrays["label"] = self.metadata.label
+        if self.metadata.weight is not None:
+            arrays["weight"] = self.metadata.weight
+        if self.metadata.query_boundaries is not None:
+            arrays["query_boundaries"] = self.metadata.query_boundaries
+        if self.metadata.init_score is not None:
+            arrays["init_score"] = self.metadata.init_score
+        np.savez_compressed(path, __meta__=np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "BinnedDataset":
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            self = cls()
+            self.num_data = meta["num_data"]
+            self.num_total_features = meta["num_total_features"]
+            self.used_features = list(meta["used_features"])
+            self.feature_names = list(meta["feature_names"])
+            self.max_bin = meta["max_bin"]
+            self.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
+            self.X_binned = z["X_binned"]
+            self.metadata = Metadata(self.num_data)
+            if "label" in z:
+                self.metadata.set_label(z["label"])
+            if "weight" in z:
+                self.metadata.set_weight(z["weight"])
+            if "query_boundaries" in z:
+                qb = z["query_boundaries"]
+                self.metadata.query_boundaries = qb.astype(np.int32)
+            if "init_score" in z:
+                self.metadata.set_init_score(z["init_score"])
+        return self
