@@ -1,0 +1,71 @@
+"""Benchmark: HIGGS-like binary GBDT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): reference CPU trains HIGGS (10.5M rows x 28
+features, 500 iters, num_leaves=255) in 238.5 s => 2.096 iters/sec on a
+28-core Xeon pair. We measure boosting iters/sec on a synthetic HIGGS-shaped
+problem sized to fit this chip's HBM comfortably, then report
+rows-normalized iters/sec (iters/sec * rows / HIGGS_rows) against the
+reference's 2.096.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HIGGS_ROWS = 10_500_000
+HIGGS_FEATURES = 28
+BASELINE_ITERS_PER_SEC = 500.0 / 238.505   # docs/Experiments.rst:104-112
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    f = HIGGS_FEATURES
+    num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    warmup = 2
+
+    r = np.random.RandomState(0)
+    X = r.randn(n, f).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.5 * np.sin(X[:, 3] * 3)
+          + 0.3 * r.randn(n)) > 0).astype(np.float32)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+
+    cfg = Config({"objective": "binary", "num_leaves": num_leaves,
+                  "max_bin": 255, "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+
+    for _ in range(warmup):
+        b.train_one_iter()
+    import jax
+    jax.block_until_ready(b.scores)
+    t0 = time.time()
+    for _ in range(iters):
+        b.train_one_iter()
+    jax.block_until_ready(b.scores)
+    dt = time.time() - t0
+
+    iters_per_sec = iters / dt
+    # normalize to HIGGS scale: assume throughput ~ rows/sec at fixed depth
+    higgs_equiv_iters_per_sec = iters_per_sec * (n / HIGGS_ROWS)
+    vs_baseline = higgs_equiv_iters_per_sec / BASELINE_ITERS_PER_SEC
+    print(json.dumps({
+        "metric": "boosting_iters_per_sec_higgs_equivalent "
+                  "(binary GBDT, %dk rows x %d feat, %d leaves, 255 bins)"
+                  % (n // 1000, f, num_leaves),
+        "value": round(higgs_equiv_iters_per_sec, 4),
+        "unit": "iters/sec (normalized to 10.5M rows)",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,534 @@
+"""User-facing Dataset and Booster.
+
+LightGBM-compatible Python API surface (reference:
+python-package/lightgbm/basic.py — Dataset :656, Booster :1578), implemented
+directly over the TPU-native core instead of ctypes into a C library. The
+lazy-construction contract is preserved: a ``Dataset`` holds raw data + params
+until ``construct()`` bins it (``_lazy_init`` analog, basic.py:693-800);
+validation sets bin with the training set's mappers via ``reference``.
+"""
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+from .config import Config, param_dict_to_str
+from .log import Log, LightGBMError, check
+from .io.dataset import BinnedDataset, Metadata
+from .io import model_text
+from .objectives import create_objective
+from .metrics import create_metric, default_metric_for_objective
+from .boosting import create_boosting
+
+_label_from_pandas_warned = False
+
+
+def _to_2d_float(data) -> np.ndarray:
+    """Accept ndarray / list / pandas DataFrame / scipy sparse."""
+    if hasattr(data, "values") and hasattr(data, "dtypes"):  # DataFrame
+        data = data.values
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    check(arr.ndim == 2, "Data must be 2-D")
+    return arr
+
+
+def _to_1d(x) -> Optional[np.ndarray]:
+    if x is None:
+        return None
+    if hasattr(x, "values"):
+        x = x.values
+    return np.asarray(x, dtype=np.float64).reshape(-1)
+
+
+class Dataset:
+    """Dataset in LightGBM (basic.py:656): lazily-binned training data."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None, silent=False,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.silent = silent
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = copy.deepcopy(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self.used_indices: Optional[np.ndarray] = None
+        self._binned: Optional[BinnedDataset] = None
+        self._predictor = None  # _InnerPredictor for continued training
+        self.pandas_categorical = None
+
+    # ------------------------------------------------------------ construct
+    def construct(self) -> "Dataset":
+        """Lazy init (basic.py _lazy_init:693-800)."""
+        if self._binned is not None:
+            return self
+        ref_binned = None
+        if self.reference is not None:
+            ref_binned = self.reference.construct()._binned
+        params = dict(self.params)
+        cfg = Config(params)
+
+        data = self.data
+        if isinstance(data, str):
+            # file path; supports the "bin once" .npz cache
+            if data.endswith(".npz") or data.endswith(".bin"):
+                self._binned = BinnedDataset.load_binary(data)
+                return self
+            from .io.parser import parse_file
+            X, y, names = parse_file(data, has_header=cfg.header,
+                                     label_column=cfg.label_column)
+            if self.label is None:
+                self.label = y
+            if self.feature_name == "auto" and names:
+                self.feature_name = names
+            data = X
+
+        X = _to_2d_float(data)
+        label = _to_1d(self.label)
+        feature_names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+
+        cat = self.categorical_feature
+        if cat == "auto" or cat is None:
+            cat = None
+        if self.used_indices is not None:
+            # subset construction (basic.py subset/used_indices path)
+            X = X[self.used_indices]
+            if label is not None:
+                label = label[self.used_indices]
+
+        weight = _to_1d(self.weight)
+        init_score = _to_1d(self.init_score)
+        group = self.group
+        if self.used_indices is not None and weight is not None:
+            weight = weight[self.used_indices]
+        if self.used_indices is not None and init_score is not None:
+            init_score = init_score[self.used_indices]
+
+        self._binned = BinnedDataset.from_matrix(
+            X, cfg, label=label, weight=weight, group=group,
+            init_score=init_score, feature_names=feature_names,
+            categorical_feature=cat, reference=ref_binned)
+        self._raw_X = None if self.free_raw_data else X
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, silent=False, params=None) -> "Dataset":
+        """basic.py:843: validation set aligned to this Dataset's binning."""
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, silent=silent,
+                       params=params or self.params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing this dataset's raw data (basic.py:1100s)."""
+        ds = Dataset(self.data, label=self.label, reference=self.reference,
+                     weight=self.weight, group=self.group,
+                     init_score=self.init_score,
+                     feature_name=self.feature_name,
+                     categorical_feature=self.categorical_feature,
+                     params=params or self.params,
+                     free_raw_data=self.free_raw_data)
+        ds.used_indices = np.asarray(sorted(used_indices), dtype=np.int64)
+        if self._binned is not None and self.reference is None:
+            ds.reference = self
+        return ds
+
+    # ------------------------------------------------------------ fields
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._binned is not None:
+            self._binned.metadata.set_label(_to_1d(label))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._binned is not None:
+            self._binned.metadata.set_weight(_to_1d(weight))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._binned is not None:
+            self._binned.metadata.set_query(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._binned is not None:
+            self._binned.metadata.set_init_score(_to_1d(init_score))
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        check(self._binned is None,
+              "Cannot set reference after dataset was constructed")
+        self.reference = reference
+        return self
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "group" or field_name == "query":
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        raise LightGBMError("Unknown field name %s" % field_name)
+
+    def get_field(self, field_name: str):
+        m = self.construct()._binned.metadata
+        if field_name == "label":
+            return m.label
+        if field_name == "weight":
+            return m.weight
+        if field_name in ("group", "query"):
+            if m.query_boundaries is None:
+                return None
+            return np.diff(m.query_boundaries)
+        if field_name == "init_score":
+            return m.init_score
+        raise LightGBMError("Unknown field name %s" % field_name)
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        return self.get_field("group")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        return self.construct()._binned.num_data
+
+    def num_feature(self) -> int:
+        return self.construct()._binned.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        return list(self.construct()._binned.feature_names)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """basic.py:1312 / dataset.h:394 SaveBinaryFile."""
+        self.construct()._binned.save_binary(filename)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        check(self._binned is None,
+              "Cannot set categorical feature after dataset was constructed")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def _set_predictor(self, predictor) -> "Dataset":
+        self._predictor = predictor
+        return self
+
+
+class _InnerPredictor:
+    """Continued-training predictor (basic.py:346): supplies init scores for
+    a new training run from an existing model."""
+
+    def __init__(self, booster: "Booster", num_iteration: int = -1):
+        self.booster = booster
+        self.num_iteration = num_iteration
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        return self.booster.predict(
+            X, num_iteration=self.num_iteration
+            if self.num_iteration > 0 else None, raw_score=True)
+
+
+class Booster:
+    """Booster in LightGBM (basic.py:1578)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent=False):
+        self.params = copy.deepcopy(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+        self._loaded = None      # parsed model dict when created from file/str
+        self._train_set: Optional[Dataset] = None
+        self._impl = None        # boosting driver (GBDT/DART/GOSS/RF)
+        self._objective = None
+        self.pandas_categorical = None
+
+        if train_set is not None:
+            check(isinstance(train_set, Dataset),
+                  "Training data should be Dataset instance")
+            self._init_from_train_set(train_set)
+        elif model_file is not None:
+            with open(model_file, "r") as fh:
+                self._init_from_string(fh.read())
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        else:
+            # params-only booster (used by set_network-style workflows)
+            self.config = Config(self.params)
+
+    # ------------------------------------------------------------ init paths
+    def _init_from_train_set(self, train_set: Dataset) -> None:
+        train_set.params = {**train_set.params, **self.params} \
+            if train_set._binned is None else train_set.params
+        train_set.construct()
+        self._train_set = train_set
+        self.config = Config(self.params)
+        binned = train_set._binned
+
+        self._objective = create_objective(self.config)
+        metric_names = list(self.config.metric)
+        if not metric_names:
+            default = default_metric_for_objective(self.config.objective)
+            if default:
+                metric_names = [default]
+        self._metric_names = [m for m in metric_names if m and m != "None"]
+        train_metrics = [m for m in
+                         (create_metric(n, self.config)
+                          for n in self._metric_names) if m]
+
+        # continued training: seed scores with the init model's predictions
+        if train_set._predictor is not None:
+            raw = train_set._predictor.predict_raw(
+                _to_2d_float(train_set.data)
+                if not isinstance(train_set.data, str) else None)
+            binned.metadata.set_init_score(
+                np.asarray(raw, np.float64).reshape(-1, order="F"))
+
+        self._impl = create_boosting(self.config, binned, self._objective,
+                                     train_metrics)
+        self.train_set_name = "training"
+
+    def _init_from_string(self, model_str: str) -> None:
+        parsed = model_text.parse_model_string(model_str)
+        self._loaded = parsed
+        params = dict(self.params)
+        obj_tokens = parsed["objective"].split()
+        if obj_tokens:
+            params.setdefault("objective", obj_tokens[0])
+            for tok in obj_tokens[1:]:
+                if ":" in tok:
+                    k, v = tok.split(":", 1)
+                    params.setdefault(k, v)
+                elif tok == "sqrt":
+                    params.setdefault("reg_sqrt", True)
+        if parsed["num_class"] > 1:
+            params["num_class"] = parsed["num_class"]
+        self.config = Config(params)
+        self._objective = (create_objective(self.config)
+                           if obj_tokens and obj_tokens[0] != "custom" else None)
+        # build a predict-only driver
+        from .boosting.gbdt import GBDT
+        impl = GBDT(self.config, None, None, [])
+        impl.objective = self._objective
+        impl.num_class = parsed["num_class"]
+        impl.num_tree_per_iteration = parsed["num_tree_per_iteration"]
+        impl.models = parsed["trees"]
+        impl.average_output = parsed["average_output"]
+        self._impl = impl
+        self._feature_names_loaded = parsed["feature_names"]
+        self._feature_infos_loaded = parsed["feature_infos"]
+
+    # ------------------------------------------------------------ training
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        check(isinstance(data, Dataset), "Validation data should be Dataset")
+        data.construct()
+        metrics = [m for m in (create_metric(n, self.config)
+                               for n in self._metric_names) if m]
+        self._impl.add_valid_data(data._binned, metrics)
+        self._valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting round (basic.py:1843). Returns True if stopped."""
+        if train_set is not None and train_set is not self._train_set:
+            raise LightGBMError("Replacing train_set is not supported yet")
+        if fobj is None:
+            return self._impl.train_one_iter()
+        # custom objective path (__boost, basic.py:1891)
+        grad, hess = fobj(self.__pred_for_fobj(), self._train_set)
+        return self.__boost(grad, hess)
+
+    def __pred_for_fobj(self) -> np.ndarray:
+        scores = np.array(self._impl.scores)
+        return scores[:, 0] if scores.shape[1] == 1 else scores.reshape(-1, order="F")
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, np.float32)
+        hess = np.asarray(hess, np.float32)
+        return self._impl.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._impl.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self):
+        # LightGBM exposes this as a method; keep method semantics
+        return self._impl.current_iteration
+
+    def num_trees(self) -> int:
+        return len(self._impl.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self._impl.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        if self._train_set is not None:
+            return self._train_set.num_feature()
+        return len(self._feature_names_loaded)
+
+    # ------------------------------------------------------------ evaluation
+    def eval_train(self, feval=None):
+        return self.__inner_eval(self.train_set_name, 0, feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i in range(len(self._valid_sets)):
+            out.extend(self.__inner_eval(self.name_valid_sets[i], i + 1, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        if data is self._train_set:
+            return self.eval_train(feval)
+        for i, vs in enumerate(self._valid_sets):
+            if data is vs:
+                return self.__inner_eval(name, i + 1, feval)
+        raise LightGBMError("Data should be a validation set added via add_valid")
+
+    def __inner_eval(self, name: str, data_idx: int, feval=None):
+        out = [(name, m, v, bb)
+               for _, m, v, bb in self._impl.get_eval_at(data_idx)]
+        if feval is not None:
+            if data_idx == 0:
+                ds = self._train_set
+                scores = np.array(self._impl.scores)
+            else:
+                ds = self._valid_sets[data_idx - 1]
+                scores = np.array(
+                    self._impl._valid_pred_cache[data_idx - 1]["scores"])
+            preds = scores[:, 0] if scores.shape[1] == 1 \
+                else scores.reshape(-1, order="F")
+            res = feval(preds, ds)
+            if isinstance(res, list):
+                for r in res:
+                    out.append((name, r[0], r[1], r[2]))
+            elif res is not None:
+                out.append((name, res[0], res[1], res[2]))
+        return out
+
+    # ------------------------------------------------------------ prediction
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if isinstance(data, Dataset):
+            raise LightGBMError("Cannot use Dataset instance for prediction, "
+                                "please use raw data instead")
+        X = _to_2d_float(data)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 \
+                else None
+        if pred_contrib:
+            return self._impl_predict_contrib(X, num_iteration)
+        return self._impl.predict(X, num_iteration=num_iteration,
+                                  raw_score=raw_score, pred_leaf=pred_leaf)
+
+    def _impl_predict_contrib(self, X, num_iteration):
+        from .core.shap import predict_contrib
+        return predict_contrib(self._impl, X, num_iteration)
+
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
+        from .engine import train as _train_fn
+        raise LightGBMError("refit is not implemented yet")
+
+    # ------------------------------------------------------------ model IO
+    def _feature_names(self) -> List[str]:
+        if self._train_set is not None:
+            return self._train_set.get_feature_name()
+        return list(self._feature_names_loaded)
+
+    def _feature_infos(self) -> List[str]:
+        if self._train_set is not None:
+            return self._train_set.construct()._binned.get_feature_infos()
+        return list(self._feature_infos_loaded)
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 \
+                else -1
+        return model_text.model_to_string(
+            self._impl, self._feature_names(), self._feature_infos(),
+            num_iteration=num_iteration, start_iteration=start_iteration,
+            parameters=param_dict_to_str(self.params))
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None) -> Dict:
+        import json
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 \
+                else -1
+        return json.loads(model_text.model_to_json(
+            self._impl, self._feature_names(), self._feature_infos(),
+            num_iteration=num_iteration))
+
+    # ------------------------------------------------------------ insight
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        imp = self._impl.feature_importance(importance_type, iteration)
+        if importance_type == "split":
+            return imp.astype(np.int64)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return self._feature_names()
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """basic.py reset_parameter → learning-rate etc. mid-training."""
+        self.params.update(params)
+        self.config.set(params)
+        if self._impl is not None:
+            self._impl.shrinkage_rate = self.config.learning_rate
+        return self
+
+    def set_network(self, machines, local_listen_port=12400,
+                    listen_time_out=120, num_machines=1) -> "Booster":
+        """Multi-host topology configuration (basic.py:1734). On TPU the
+        actual collectives ride the ICI/DCN mesh via jax.distributed."""
+        from .parallel import network
+        network.init(machines=machines, local_listen_port=local_listen_port,
+                     time_out=listen_time_out, num_machines=num_machines)
+        return self
+
+    def free_network(self) -> "Booster":
+        from .parallel import network
+        network.free()
+        return self

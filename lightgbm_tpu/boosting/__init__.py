@@ -1,0 +1,30 @@
+"""Boosting drivers (include/LightGBM/boosting.h:22-294).
+
+Factory mirrors Boosting::CreateBoosting (src/boosting/boosting.cpp:30-45):
+"gbdt" | "dart" | "goss" | "rf".
+"""
+from typing import List, Optional
+
+from ..config import Config
+from ..log import LightGBMError
+from .gbdt import GBDT, HostTree
+
+
+def create_boosting(config: Config, train_data=None, objective=None,
+                    metrics: Optional[List] = None):
+    name = config.boosting
+    if name == "gbdt":
+        return GBDT(config, train_data, objective, metrics)
+    if name == "dart":
+        from .dart import DART
+        return DART(config, train_data, objective, metrics)
+    if name == "goss":
+        from .goss import GOSS
+        return GOSS(config, train_data, objective, metrics)
+    if name == "rf":
+        from .rf import RF
+        return RF(config, train_data, objective, metrics)
+    raise LightGBMError("Unknown boosting type %s" % name)
+
+
+__all__ = ["GBDT", "HostTree", "create_boosting"]

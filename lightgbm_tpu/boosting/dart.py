@@ -1,0 +1,127 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+TPU-native re-design of src/boosting/dart.hpp:40-205. Semantics preserved:
+per-iteration drop set chosen by ``drop_rate`` (uniform or tree-weighted),
+skipped entirely with probability ``skip_drop``, capped at ``max_drop``;
+the new tree is trained against scores with the dropped trees removed and
+shrunk by ``lr / (1 + k)`` (or ``lr / (lr + k)`` in xgboost mode); dropped
+trees are then normalized by ``k / (k + 1)`` (xgboost: ``k / (lr + k)``) and
+train/valid scores adjusted to match (dart.hpp Normalize :141-186).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from .gbdt import GBDT, HostTree
+
+
+class DART(GBDT):
+    boosting_type = "dart"
+
+    def __init__(self, config: Config, train_data, objective, metrics=None):
+        super().__init__(config, train_data, objective, metrics)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+
+    def _dropping_trees(self) -> List[int]:
+        """Select iteration indices to drop (dart.hpp DroppingTrees:88-139)."""
+        cfg = self.config
+        drop_index: List[int] = []
+        if self._drop_rng.rand() < cfg.skip_drop:
+            return drop_index
+        drop_rate = cfg.drop_rate
+        n_iter = self.iter_
+        if not cfg.uniform_drop and self.sum_weight > 0:
+            inv_avg = len(self.tree_weight) / self.sum_weight
+            if cfg.max_drop > 0:
+                drop_rate = min(drop_rate, cfg.max_drop * inv_avg / self.sum_weight)
+            for i in range(n_iter):
+                if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                    drop_index.append(i)
+                    if len(drop_index) >= cfg.max_drop > 0:
+                        break
+        else:
+            if cfg.max_drop > 0 and n_iter > 0:
+                drop_rate = min(drop_rate, cfg.max_drop / float(n_iter))
+            for i in range(n_iter):
+                if self._drop_rng.rand() < drop_rate:
+                    drop_index.append(i)
+                    if len(drop_index) >= cfg.max_drop > 0:
+                        break
+        return drop_index
+
+    def _tree_delta(self, ht: HostTree, xb) -> jnp.ndarray:
+        """Replay one tree's (shrunk) output on a binned matrix."""
+        leaf = self._replay_leaves_binned(ht, xb)
+        return jnp.asarray(ht.leaf_value.astype(np.float32))[leaf]
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        cfg = self.config
+        k_cls = self.num_tree_per_iteration
+        drop_index = self._dropping_trees()
+        k = float(len(drop_index))
+
+        # remove dropped trees from train/valid scores (DroppingTrees :125-131)
+        train_deltas = {}   # (iter i, class c) -> [N] device array
+        valid_deltas = {}
+        for i in drop_index:
+            for c in range(k_cls):
+                ht = self.models[i * k_cls + c]
+                d = self._tree_delta(ht, self.xb)
+                train_deltas[(i, c)] = d
+                self.scores = self.scores.at[:, c].add(-d)
+                for vi, cache in self._valid_pred_cache.items():
+                    dv = self._tree_delta(ht, cache["xb"])
+                    valid_deltas[(vi, i, c)] = dv
+                    cache["scores"] = cache["scores"].at[:, c].add(-dv)
+
+        # new-tree shrinkage (dart.hpp :133-139)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if not drop_index else
+                                   cfg.learning_rate / (cfg.learning_rate + k))
+
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            # restore the dropped trees' contribution before bailing out
+            for (i, c), d in train_deltas.items():
+                self.scores = self.scores.at[:, c].add(d)
+            for (vi, i, c), dv in valid_deltas.items():
+                self._valid_pred_cache[vi]["scores"] = \
+                    self._valid_pred_cache[vi]["scores"].at[:, c].add(dv)
+            return ret
+
+        # Normalize (dart.hpp :141-186): dropped trees scaled in place and
+        # their scaled output restored to the scores.
+        if drop_index:
+            if not cfg.xgboost_dart_mode:
+                factor = k / (k + 1.0)
+            else:
+                factor = k / (cfg.learning_rate + k)
+            for i in drop_index:
+                for c in range(k_cls):
+                    ht = self.models[i * k_cls + c]
+                    ht.shrink(factor)
+                    self.scores = self.scores.at[:, c].add(
+                        train_deltas[(i, c)] * factor)
+                    for vi, cache in self._valid_pred_cache.items():
+                        cache["scores"] = cache["scores"].at[:, c].add(
+                            valid_deltas[(vi, i, c)] * factor)
+                if not cfg.uniform_drop:
+                    if not cfg.xgboost_dart_mode:
+                        self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
+                    else:
+                        self.sum_weight -= self.tree_weight[i] * \
+                            (1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[i] *= factor
+
+        if not cfg.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False

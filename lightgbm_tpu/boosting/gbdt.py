@@ -80,21 +80,7 @@ class HostTree:
 
     def predict_table(self, max_nodes: int, max_leaves: int) -> tree_mod.PredictTree:
         """Pad to model-wide fixed shapes for stacked device prediction."""
-        def pad(a, n, fill=0):
-            out = np.full((n,) + a.shape[1:], fill, a.dtype)
-            out[:len(a)] = a
-            return out
-        return tree_mod.PredictTree(
-            split_leaf=pad(self.split_leaf, max_nodes, -1),
-            split_feature=pad(self.split_feature, max_nodes),
-            threshold=pad(self.threshold.astype(np.float32), max_nodes),
-            threshold_bin=pad(self.threshold_bin, max_nodes),
-            default_left=pad(self.default_left, max_nodes),
-            missing_type=pad(self.missing_type, max_nodes),
-            is_categorical=pad(self.is_categorical, max_nodes),
-            cat_bitset=pad(self.cat_bitset, max_nodes),
-            leaf_value=pad(self.leaf_value.astype(np.float32), max_leaves),
-        )
+        return tree_mod.pack_predict_table(self, max_nodes, max_leaves)
 
 
 def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta:
@@ -146,6 +132,10 @@ class GBDT:
             objective.num_model_per_iteration if objective is not None
             else max(1, config.num_class))
         self.shrinkage_rate = config.learning_rate
+        # subclasses (RF) force the grad_in/hess_in path even with an objective
+        self._use_input_grads = False
+        self.mesh = None
+        self._row_valid = None
 
         if train_data is not None:
             self._setup_train(train_data)
@@ -153,12 +143,40 @@ class GBDT:
     # ------------------------------------------------------------ setup
     def _setup_train(self, ds: BinnedDataset) -> None:
         cfg = self.config
-        self.num_data = ds.num_data
+        from ..parallel import mesh as mesh_mod
+        self.mesh = mesh_mod.build_mesh(cfg)
+        self.num_data_orig = ds.num_data
+        xb_np = ds.X_binned
+        row_valid = None
+        if self.mesh is not None:
+            # pad rows to a multiple of the data-axis size so every shard is
+            # even; padded rows carry mask 0 everywhere (the distributed
+            # loader's row partition, dataset_loader.cpp:469-495, without the
+            # loss of remainder rows)
+            axis = mesh_mod.DATA_AXIS
+            dsize = (self.mesh.shape[axis]
+                     if axis in self.mesh.axis_names else 1)
+            pad = (-ds.num_data) % dsize
+            if pad:
+                xb_np = np.concatenate(
+                    [xb_np, np.zeros((pad, xb_np.shape[1]), xb_np.dtype)])
+            if pad:
+                row_valid = np.concatenate(
+                    [np.ones(ds.num_data, np.float32),
+                     np.zeros(pad, np.float32)])
+        self.num_data = xb_np.shape[0]
+        self._row_valid = (jnp.asarray(row_valid) if row_valid is not None
+                           else None)
         self.feature_meta = _feature_meta_from_dataset(ds, cfg)
         self.num_bins = max(ds.max_num_bin(), 2)
-        self.xb = jnp.asarray(ds.X_binned)
+        self.xb = jnp.asarray(xb_np)
+        if self.mesh is not None:
+            self.xb = jax.device_put(
+                self.xb, mesh_mod.feature_sharding(self.mesh))
         if self.objective is not None:
             self.objective.init(ds.metadata, ds.num_data)
+            if self.mesh is not None:
+                self.objective.pad_to(self.num_data, self.mesh)
         for m in self.train_metrics:
             m.init(ds.metadata, ds.num_data)
 
@@ -181,16 +199,21 @@ class GBDT:
 
         k = self.num_tree_per_iteration
         n = self.num_data
+        n0 = self.num_data_orig
         init_scores = np.zeros((n, k), np.float32)
         # init score from file/metadata (ScoreUpdater ctor :32-51)
         if ds.metadata.init_score is not None:
             isc = np.asarray(ds.metadata.init_score, np.float32).reshape(-1)
-            if len(isc) == n * k:
-                init_scores = isc.reshape(k, n).T.copy()
+            if len(isc) == n0 * k:
+                init_scores[:n0] = isc.reshape(k, n0).T
             else:
-                init_scores = np.tile(isc.reshape(-1, 1), (1, k))
+                init_scores[:n0] = np.tile(isc.reshape(-1, 1), (1, k))
         self._init_scores_provided = ds.metadata.init_score is not None
         self.scores = jnp.asarray(init_scores)
+        if self.mesh is not None:
+            from ..parallel import mesh as mesh_mod
+            self.scores = jax.device_put(
+                self.scores, mesh_mod.row_sharding(self.mesh, extra_dims=1))
         self.boost_from_average_done = False
         self._rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
@@ -253,12 +276,18 @@ class GBDT:
         """Row bagging (gbdt.cpp:180-241); resampled every bagging_freq."""
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
-            return self._bag_mask
+            return self._apply_row_valid(self._bag_mask)
         if iter_idx % cfg.bagging_freq == 0:
             self._bag_key, sub = jax.random.split(self._bag_key)
             u = jax.random.uniform(sub, (self.num_data,))
             self._bag_mask = (u < cfg.bagging_fraction).astype(jnp.float32)
-        return self._bag_mask
+        return self._apply_row_valid(self._bag_mask)
+
+    def _apply_row_valid(self, mask: jnp.ndarray) -> jnp.ndarray:
+        """Exclude padded rows (even-sharding padding) from training."""
+        if self._row_valid is not None:
+            return mask * self._row_valid
+        return mask
 
     def _make_train_iter_fn(self) -> Callable:
         """Build the jitted per-iteration function."""
@@ -267,13 +296,19 @@ class GBDT:
         xb = self.xb
         obj = self.objective
         k = self.num_tree_per_iteration
-        lr = self.shrinkage_rate
+        n = self.num_data
+        use_input = self._use_input_grads or obj is None
+        is_goss = self.boosting_type == "goss"
+        if is_goss:
+            top_cnt = max(1, int(n * self.config.top_rate))
+            other_cnt = max(1, int(n * self.config.other_rate))
+            goss_multiply = float(n - top_cnt) / other_cnt
 
         @jax.jit
         def run_iter(scores, sample_mask, feature_mask,
-                     grad_in, hess_in):
+                     grad_in, hess_in, lr, goss_active, goss_key):
             # gradients: objective or custom (grad_in) (gbdt.cpp:333-347)
-            if obj is not None:
+            if not use_input:
                 if k == 1:
                     g, h = obj.get_gradients(scores[:, 0])
                     g = g[:, None]
@@ -282,6 +317,28 @@ class GBDT:
                     g, h = obj.get_gradients(scores)
             else:
                 g, h = grad_in, hess_in
+
+            if is_goss:
+                # GOSS one-side sampling on device (goss.hpp:87-135): keep all
+                # of the top |g*h| rows, sample the rest, amplify their
+                # grad/hess by (n - top)/other so expectations are unbiased.
+                # Warmup iterations (goss_active == 0) skip the sort entirely.
+                def goss_mult(_):
+                    gh = jnp.sum(jnp.abs(g * h), axis=1)
+                    thr = jax.lax.top_k(gh, top_cnt)[0][-1]
+                    is_top = gh >= thr
+                    u = jax.random.uniform(goss_key, (n,))
+                    p_rest = other_cnt / max(n - top_cnt, 1)
+                    keep_other = (~is_top) & (u < p_rest)
+                    return jnp.where(is_top, 1.0,
+                                     jnp.where(keep_other, goss_multiply, 0.0))
+
+                mult = jax.lax.cond(goss_active > 0, goss_mult,
+                                    lambda _: jnp.ones((n,), jnp.float32),
+                                    operand=None)
+                g = g * mult[:, None]
+                h = h * mult[:, None]
+                sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
 
             def grow_one(gk, hk):
                 return grow_tree(xb, gk, hk, sample_mask, meta, feature_mask,
@@ -295,6 +352,9 @@ class GBDT:
             return trees, leaf_ids, new_scores, g, h
 
         return run_iter
+
+    def _goss_active(self, iter_idx: int) -> float:
+        return 0.0
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -318,12 +378,17 @@ class GBDT:
             h_in = jnp.asarray(np.asarray(hess, np.float32).reshape(k, n).T
                                if np.asarray(hess).ndim == 1 and k > 1
                                else np.asarray(hess, np.float32).reshape(n, k))
+        elif self._use_input_grads:
+            g_in, h_in = self._fixed_gradients()
         else:
             g_in = jnp.zeros((n, k), jnp.float32)
             h_in = jnp.ones((n, k), jnp.float32)
 
+        self._bag_key, goss_key = jax.random.split(self._bag_key)
         trees, leaf_ids, new_scores, g, h = self._compiled_iter(
-            self.scores, sample_mask, feature_mask, g_in, h_in)
+            self.scores, sample_mask, feature_mask, g_in, h_in,
+            jnp.float32(self.shrinkage_rate),
+            jnp.float32(self._goss_active(iter_idx)), goss_key)
 
         # pull tree arrays to host, convert thresholds, store
         trees_np = jax.tree.map(np.asarray, trees)
@@ -383,21 +448,22 @@ class GBDT:
         """Percentile leaf refit for L1/quantile/MAPE objectives
         (regression_objective.hpp RenewTreeOutput; host-side for now)."""
         alpha = self.objective.renew_percentile()
-        label = np.asarray(self.objective.label)
-        w = (np.asarray(self.objective.weights)
+        n0 = self.num_data_orig
+        label = np.asarray(self.objective.label)[:n0]
+        w = (np.asarray(self.objective.weights)[:n0]
              if self.objective.weights is not None else np.ones_like(label))
         if hasattr(self.objective, "label_weight") and \
                 self.objective.name == "mape":
-            w = np.asarray(self.objective.label_weight)
-        scores_np = np.asarray(self.scores)
+            w = np.asarray(self.objective.label_weight)[:n0]
+        scores_np = np.array(self.scores)
         leaf_ids_np = np.asarray(leaf_ids)
-        mask = np.asarray(sample_mask) > 0
+        mask = np.asarray(sample_mask)[:n0] > 0
         k = self.num_tree_per_iteration
         from ..objectives import _weighted_percentile
         for c in range(k):
             ht = host_trees[c]
-            resid = label - scores_np[:, c]
-            li = leaf_ids_np[c]
+            resid = label - scores_np[:n0, c]
+            li = leaf_ids_np[c][:n0]
             for leaf in range(ht.num_leaves_actual):
                 sel = (li == leaf) & mask
                 if sel.any():
@@ -405,7 +471,7 @@ class GBDT:
                         resid[sel], w[sel], alpha)
             # rebuild score delta with renewed (pre-shrinkage) values; the
             # shrinkage is applied when the tree is stored
-            scores_np[:, c] += ht.leaf_value[li] * self.shrinkage_rate
+            scores_np[:, c] += ht.leaf_value[leaf_ids_np[c]] * self.shrinkage_rate
         return jnp.asarray(scores_np)
 
     def _extract_host_tree(self, t) -> HostTree:
@@ -506,7 +572,7 @@ class GBDT:
         conv = (self.objective.convert_output if self.objective is not None
                 else None)
         if data_idx == 0:
-            scores = np.asarray(self.scores)
+            scores = np.asarray(self.scores)[:self.num_data_orig]
             for m in self.train_metrics:
                 vals = m.eval(scores if self.num_tree_per_iteration > 1
                               else scores[:, 0], conv)

@@ -1,0 +1,30 @@
+"""GOSS boosting (Gradient-based One-Side Sampling).
+
+TPU-native re-design of src/boosting/goss.hpp. The sampling itself runs on
+device inside the jitted iteration (see GBDT._make_train_iter_fn's is_goss
+branch): top ``top_rate`` rows by sum-over-classes |grad*hess| are always
+kept; the rest are Bernoulli-sampled at ``other_rate / (1 - top_rate)`` and
+their grad/hess amplified by ``(n - top)/other`` (goss.hpp BaggingHelper
+:87-135). Like the reference, sampling is disabled for the first
+``1 / learning_rate`` iterations (goss.hpp Bagging :137-140).
+"""
+from __future__ import annotations
+
+from ..config import Config
+from ..log import LightGBMError
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    boosting_type = "goss"
+
+    def __init__(self, config: Config, train_data, objective, metrics=None):
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            raise LightGBMError("Cannot use bagging in GOSS")
+        if not (config.top_rate > 0.0 and config.other_rate > 0.0):
+            raise LightGBMError("GOSS needs top_rate > 0 and other_rate > 0")
+        super().__init__(config, train_data, objective, metrics)
+
+    def _goss_active(self, iter_idx: int) -> float:
+        warmup = int(1.0 / max(self.config.learning_rate, 1e-12))
+        return 1.0 if iter_idx >= warmup else 0.0

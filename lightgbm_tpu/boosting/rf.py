@@ -1,0 +1,101 @@
+"""Random Forest mode.
+
+TPU-native re-design of src/boosting/rf.hpp: ``average_output`` on, bagging
+mandatory, no shrinkage (rate 1.0), and every tree is fit to gradients
+computed ONCE at the objective's init score (rf.hpp Boosting :76-95) — so
+trees are independent given the bagging masks. Each tree gets the init score
+folded in via AddBias (rf.hpp :118-121) and the model output is the average
+over iterations (GBDT::average_output handling).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..log import LightGBMError
+from .gbdt import GBDT, HostTree
+
+
+class RF(GBDT):
+    boosting_type = "rf"
+    average_output = True
+
+    def __init__(self, config: Config, train_data, objective, metrics=None):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            raise LightGBMError(
+                "Random forest needs bagging_freq > 0 and "
+                "bagging_fraction in (0, 1)")
+        super().__init__(config, train_data, objective, metrics)
+        self.shrinkage_rate = 1.0
+        self._use_input_grads = True
+        self._grad_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
+        self._init_scores_rf = np.zeros(self.num_tree_per_iteration, np.float32)
+        # scores hold the running SUM of tree outputs; eval views divide by
+        # the iteration count (score_updater MultiplyScore dance, rf.hpp)
+        self._score_sum = self.scores
+        self._valid_score_sum = {}
+
+    def _fixed_gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Gradients at the constant init score (rf.hpp Boosting :76-95)."""
+        if self._grad_cache is None:
+            k = self.num_tree_per_iteration
+            n = self.num_data
+            if self.config.boost_from_average and self.objective is not None:
+                self._init_scores_rf = np.array(
+                    [self.objective.boost_from_score(c) for c in range(k)],
+                    np.float32)
+            base = jnp.broadcast_to(jnp.asarray(self._init_scores_rf)[None, :],
+                                    (n, k))
+            if k == 1:
+                g, h = self.objective.get_gradients(base[:, 0])
+                g, h = g[:, None], h[:, None]
+            else:
+                g, h = self.objective.get_gradients(base)
+            self._grad_cache = (g, h)
+        return self._grad_cache
+
+    def _boost_from_average(self) -> None:
+        # RF does not seed the running scores; init score lives in each tree
+        # via AddBias instead (rf.hpp :118-121).
+        self.boost_from_average_done = True
+        self.init_score_offsets = np.zeros(self.num_tree_per_iteration,
+                                           np.float32)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        prev_sum = self._score_sum
+        n_before = len(self.models)
+        # make super() accumulate onto the raw sums (cache["scores"] holds the
+        # averaged view between iterations; the raw sums live in
+        # _valid_score_sum / _score_sum)
+        self.scores = prev_sum
+        for vi, cache in self._valid_pred_cache.items():
+            cache["scores"] = self._valid_score_sum.get(vi, cache["scores"])
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            it = float(max(self.current_iteration, 1))
+            self.scores = self._score_sum / it
+            for vi, cache in self._valid_pred_cache.items():
+                self._valid_score_sum[vi] = cache["scores"]
+                cache["scores"] = cache["scores"] / it
+            return ret
+        k = self.num_tree_per_iteration
+        # AddBias: fold the init score into the new trees + their score deltas
+        new_trees = self.models[n_before:]
+        for c, ht in enumerate(new_trees):
+            bias = float(self._init_scores_rf[c])
+            if abs(bias) > 1e-15:
+                ht.leaf_value += bias
+                ht.internal_value += bias
+                self.scores = self.scores.at[:, c].add(bias)
+                for cache in self._valid_pred_cache.values():
+                    cache["scores"] = cache["scores"].at[:, c].add(bias)
+        self._score_sum = self.scores
+        it = float(self.current_iteration)
+        self.scores = self._score_sum / it
+        for vi, cache in self._valid_pred_cache.items():
+            self._valid_score_sum[vi] = cache["scores"]
+            cache["scores"] = cache["scores"] / it
+        return False

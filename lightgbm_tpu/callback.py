@@ -1,0 +1,166 @@
+"""Training callbacks (reference: python-package/lightgbm/callback.py).
+
+Same contract: callbacks receive a ``CallbackEnv`` namedtuple before/after
+each iteration; ``EarlyStopException`` unwinds the training loop
+(callback.py:16-31, 55-153).
+"""
+from __future__ import annotations
+
+import collections
+from operator import gt, lt
+from typing import Any, Callable, Dict, List
+
+from .log import Log
+
+
+class EarlyStopException(Exception):
+    """Signals the train loop to stop (callback.py:16)."""
+
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _format_eval_result(value, show_stdv: bool = True) -> str:
+    """callback.py:34-46."""
+    if len(value) == 4:
+        return "%s's %s: %g" % (value[0], value[1], value[2])
+    if len(value) == 5:
+        if show_stdv:
+            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
+        return "%s's %s: %g" % (value[0], value[1], value[2])
+    raise ValueError("Wrong metric value")
+
+
+def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """callback.py:49-72."""
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(
+                _format_eval_result(x, show_stdv)
+                for x in env.evaluation_result_list)
+            Log.info("[%d]\t%s", env.iteration + 1, result)
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    """callback.py:75-105."""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+    eval_result.clear()
+
+    def _init(env: CallbackEnv) -> None:
+        for data_name, eval_name, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for data_name, eval_name, result, _ in env.evaluation_result_list:
+            eval_result[data_name][eval_name].append(result)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    """callback.py:108-146: per-iteration parameter schedules; values may be
+    lists (indexed by iteration) or callables iteration -> value."""
+    def _callback(env: CallbackEnv) -> None:
+        new_parameters = {}
+        for key, value in kwargs.items():
+            if key in ("num_class", "num_classes", "boosting", "boost",
+                       "boosting_type", "metric", "metrics", "metric_types"):
+                raise RuntimeError("Cannot reset %s during training" % repr(key))
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        "Length of list %r has to equal to 'num_boost_round'."
+                        % key)
+                new_param = value[env.iteration - env.begin_iteration]
+            else:
+                new_param = value(env.iteration - env.begin_iteration)
+            if new_param != env.params.get(key, None):
+                new_parameters[key] = new_param
+        if new_parameters:
+            env.model.reset_parameter(new_parameters)
+            env.params.update(new_parameters)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True) -> Callable:
+    """callback.py:149-236."""
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[Any] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = not any(
+            env.params.get(alias, "") == "dart"
+            for alias in ("boosting", "boosting_type", "boost"))
+        if not enabled[0]:
+            Log.warning("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric is "
+                "required for evaluation")
+        if verbose:
+            Log.info("Training until validation scores don't improve for %d "
+                     "rounds.", stopping_rounds)
+        for eval_ret in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if eval_ret[3]:  # bigger is better
+                best_score.append(float("-inf"))
+                cmp_op.append(gt)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lt)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, eval_ret in enumerate(env.evaluation_result_list):
+            score = eval_ret[2]
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            # train metric doesn't trigger early stop (callback.py:206-209)
+            if eval_ret[0] == "training" or eval_ret[0] == env.model.train_set_name:
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    Log.info("Early stopping, best iteration is:\n[%d]\t%s",
+                             best_iter[i] + 1, "\t".join(
+                                 _format_eval_result(x)
+                                 for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    Log.info("Did not meet early stopping. Best iteration is:"
+                             "\n[%d]\t%s", best_iter[i] + 1, "\t".join(
+                                 _format_eval_result(x)
+                                 for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if first_metric_only:
+                break
+    _callback.order = 30
+    return _callback

@@ -36,10 +36,12 @@ def _hist_chunk_matmul(xb_chunk: jnp.ndarray, vals_chunk: jnp.ndarray,
     c, f = xb_chunk.shape
     onehot = (xb_chunk[:, :, None] == jnp.arange(num_bins, dtype=xb_chunk.dtype)
               ).astype(vals_chunk.dtype)  # [C, F, B]
-    # contract over rows: [F*B, C] @ [C, 3]
+    # contract over rows: [F*B, C] @ [C, 3]. HIGHEST keeps f32 accumulation on
+    # the MXU (TPU matmuls default to bf16 inputs, which breaks the 1e-4 AUC
+    # parity budget — the analog of gpu_use_dp, config.h:784).
     return lax.dot_general(onehot, vals_chunk,
-                           (((0,), (0,)), ((), ()))
-                           )  # [F, B, 3]
+                           (((0,), (0,)), ((), ())),
+                           precision=lax.Precision.HIGHEST)  # [F, B, 3]
 
 
 def _hist_scatter(xb: jnp.ndarray, vals: jnp.ndarray, num_bins: int) -> jnp.ndarray:

@@ -1,0 +1,213 @@
+"""TreeSHAP feature contributions.
+
+Re-implementation of the path-dependent TreeSHAP algorithm (Lundberg &
+Lee 2017) matching the reference's ``PredictContrib`` semantics
+(src/io/tree.cpp:628-698 TreeSHAP/Extend/Unwind, src/boosting/gbdt.cpp
+PredictContrib): output has ``num_features + 1`` columns per class, the last
+being the expected value (bias); columns sum to the raw score.
+
+Host-side NumPy recursion for now — contribution queries are an offline
+explainability path, not the training hot loop. A vectorized device port is
+planned once categorical kernels land.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .split import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_ZERO_THRESHOLD = 1e-35
+
+
+def _children(ht, node: int):
+    """Resolve (left, right) child node ids; negative = ~leaf."""
+    return int(ht.left_child[node]), int(ht.right_child[node])
+
+
+def _node_cover(ht, node_or_leaf: int) -> float:
+    if node_or_leaf < 0:
+        return float(ht.leaf_count[~node_or_leaf])
+    return float(ht.internal_count[node_or_leaf])
+
+
+def _decision_go_left(ht, node: int, x: np.ndarray) -> bool:
+    """Raw-value decision (tree.h:212-243 NumericalDecision /
+    CategoricalDecision), mirrored from core.tree._raw_go_left."""
+    fval = x[ht.split_feature[node]]
+    missing_type = int(ht.missing_type[node])
+    if ht.is_categorical[node]:
+        if np.isnan(fval) or fval < 0 or fval >= 256:
+            return False
+        ci = int(fval)
+        return bool((int(ht.cat_bitset[node][ci >> 5]) >> (ci & 31)) & 1)
+    is_nan = bool(np.isnan(fval))
+    if missing_type != MISSING_NAN and is_nan:
+        fval = 0.0
+        is_nan = False
+    if missing_type == MISSING_NAN and is_nan:
+        return bool(ht.default_left[node])
+    if missing_type == MISSING_ZERO and abs(fval) <= K_ZERO_THRESHOLD:
+        return bool(ht.default_left[node])
+    return fval <= ht.threshold[node]
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+    def copy(self):
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend(path: List[_PathElement], unique_depth: int, zero_fraction: float,
+            one_fraction: float, feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += (one_fraction * path[i].pweight * (i + 1)
+                                / (unique_depth + 1))
+        path[i].pweight = (zero_fraction * path[i].pweight
+                           * (unique_depth - i) / (unique_depth + 1))
+
+
+def _unwind(path: List[_PathElement], unique_depth: int, path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = (next_one_portion * (unique_depth + 1)
+                               / ((i + 1) * one_fraction))
+            next_one_portion = tmp - path[i].pweight * zero_fraction * \
+                (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = (path[i].pweight * (unique_depth + 1)
+                               / (zero_fraction * (unique_depth - i)))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = (next_one_portion * (unique_depth + 1)
+                   / ((i + 1) * one_fraction))
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * \
+                ((unique_depth - i) / (unique_depth + 1))
+        else:
+            total += (path[i].pweight / zero_fraction
+                      / ((unique_depth - i) / (unique_depth + 1)))
+    return total
+
+
+def _tree_shap(ht, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int) -> None:
+    path = [p.copy() for p in parent_path[:unique_depth]] + \
+        [_PathElement() for _ in range(64)]
+    _extend(path, unique_depth, parent_zero_fraction, parent_one_fraction,
+            parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction) \
+                * float(ht.leaf_value[leaf])
+        return
+
+    left, right = _children(ht, node)
+    hot, cold = (left, right) if _decision_go_left(ht, node, x) else (right, left)
+    node_count = _node_cover(ht, node)
+    hot_zero_fraction = _node_cover(ht, hot) / node_count
+    cold_zero_fraction = _node_cover(ht, cold) / node_count
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+
+    # if we have already split on this feature, undo and combine fractions
+    split_feat = int(ht.split_feature[node])
+    path_index = next((i for i in range(1, unique_depth + 1)
+                       if path[i].feature_index == split_feat), 0)
+    if path_index > 0:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(ht, x, phi, hot, unique_depth + 1, path,
+               hot_zero_fraction * incoming_zero_fraction,
+               incoming_one_fraction, split_feat)
+    _tree_shap(ht, x, phi, cold, unique_depth + 1, path,
+               cold_zero_fraction * incoming_zero_fraction, 0.0, split_feat)
+
+
+def tree_expected_value(ht) -> float:
+    """Count-weighted mean leaf output (Tree expected value for the bias
+    column, gbdt.cpp PredictContrib era)."""
+    nl = ht.num_leaves_actual
+    counts = np.asarray(ht.leaf_count[:nl], np.float64)
+    total = counts.sum()
+    if total <= 0:
+        return float(ht.leaf_value[0])
+    return float(np.dot(counts, np.asarray(ht.leaf_value[:nl], np.float64))
+                 / total)
+
+
+def predict_contrib(impl, X: np.ndarray,
+                    num_iteration: Optional[int] = None) -> np.ndarray:
+    """SHAP contributions for a boosting model.
+
+    Returns [N, (F+1) * K]: per class, per-feature contributions plus the
+    expected-value column; rows sum (per class) to the raw score.
+    """
+    X = np.asarray(X, np.float64)
+    if X.ndim == 1:
+        X = X.reshape(1, -1)
+    n = X.shape[0]
+    k = impl.num_tree_per_iteration
+    total_iters = len(impl.models) // max(k, 1)
+    use_iters = total_iters if num_iteration is None or num_iteration <= 0 \
+        else min(num_iteration, total_iters)
+    num_feat = max(
+        (int(np.max(t.split_feature[:max(t.num_leaves_actual - 1, 0)]))
+         for t in impl.models if t.num_leaves_actual > 1), default=-1) + 1
+    if impl.train_data is not None:
+        num_feat = impl.train_data.num_total_features
+    num_feat = max(num_feat, X.shape[1])
+
+    out = np.zeros((n, k, num_feat + 1), np.float64)
+    root_path = [_PathElement() for _ in range(64)]
+    for it in range(use_iters):
+        for c in range(k):
+            ht = impl.models[it * k + c]
+            ev = tree_expected_value(ht)
+            for r in range(n):
+                out[r, c, num_feat] += ev
+                if ht.num_leaves_actual > 1:
+                    _tree_shap(ht, X[r], out[r, c, :], 0, 0, root_path,
+                               1.0, 1.0, -1)
+    if impl.average_output and use_iters > 0:
+        out /= use_iters
+    return out.reshape(n, -1) if k > 1 else out[:, 0, :]

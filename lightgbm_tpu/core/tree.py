@@ -40,6 +40,30 @@ class PredictTree(NamedTuple):
     leaf_value: jnp.ndarray      # [L] f32
 
 
+def pack_predict_table(ht, max_nodes: int, max_leaves: int) -> "PredictTree":
+    """Pad a host tree's SoA arrays to model-wide fixed shapes for stacked
+    device prediction. ``ht`` is any object with the HostTree field layout
+    (boosting.gbdt.HostTree or io.model_text.LoadedTree)."""
+    import numpy as np
+
+    def pad(a, n, fill=0):
+        out = np.full((n,) + a.shape[1:], fill, a.dtype)
+        out[:len(a)] = a
+        return out
+
+    return PredictTree(
+        split_leaf=pad(ht.split_leaf, max_nodes, -1),
+        split_feature=pad(ht.split_feature, max_nodes),
+        threshold=pad(ht.threshold.astype(np.float32), max_nodes),
+        threshold_bin=pad(ht.threshold_bin, max_nodes),
+        default_left=pad(ht.default_left, max_nodes),
+        missing_type=pad(ht.missing_type, max_nodes),
+        is_categorical=pad(ht.is_categorical, max_nodes),
+        cat_bitset=pad(ht.cat_bitset, max_nodes),
+        leaf_value=pad(ht.leaf_value.astype(np.float32), max_leaves),
+    )
+
+
 def _raw_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
                  default_left: jnp.ndarray, missing_type: jnp.ndarray,
                  is_cat: jnp.ndarray, cat_bitset: jnp.ndarray) -> jnp.ndarray:

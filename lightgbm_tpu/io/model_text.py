@@ -1,0 +1,370 @@
+"""LightGBM-compatible model text format.
+
+Re-implementation of the reference model serialization
+(src/boosting/gbdt_model_text.cpp:244-341 SaveModelToString, :343+
+LoadModelFromString; src/io/tree.cpp:207-238 Tree::ToString, :300+ Tree(str))
+so models trained here can be read by reference tooling and vice versa.
+
+Layout (kModelVersion "v2", gbdt_model_text.cpp:13):
+
+    tree
+    version=v2
+    num_class=1
+    num_tree_per_iteration=1
+    label_index=0
+    max_feature_idx=27
+    objective=binary sigmoid:1
+    feature_names=...
+    feature_infos=...
+    tree_sizes=...
+    <blank>
+    Tree=0
+    num_leaves=31
+    num_cat=0
+    split_feature=...
+    ...
+    shrinkage=0.1
+    <blank>
+    end of trees
+    feature importances / parameters blocks
+
+decision_type is bit-packed (tree.h:14-15,183-207): bit0 categorical,
+bit1 default_left, bits2-3 missing type.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..log import LightGBMError
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+_MISSING_NAMES = {0: "None", 1: "Zero", 2: "NaN"}
+
+
+def _fmt(x: float) -> str:
+    """Shortest round-trip float formatting (like C++ max_digits10 output)."""
+    if x == int(x) and abs(x) < 1e15:
+        return str(int(x))
+    return repr(float(x))
+
+
+def _join(arr, fmt=str) -> str:
+    return " ".join(fmt(v) for v in arr)
+
+
+def tree_to_string(ht, tree_index: int) -> str:
+    """One ``Tree=i`` block (tree.cpp Tree::ToString:207-238)."""
+    nn = max(ht.num_leaves_actual - 1, 0)
+    nl = ht.num_leaves_actual
+    lines = ["Tree=%d" % tree_index,
+             "num_leaves=%d" % nl]
+    # categorical bookkeeping: nodes with is_categorical store a running
+    # index into the cat_threshold bitset words (tree.cpp cat_boundaries_)
+    cat_nodes = [i for i in range(nn) if ht.is_categorical[i]]
+    num_cat = len(cat_nodes)
+    lines.append("num_cat=%d" % num_cat)
+    if nn > 0:
+        decision_type = np.zeros(nn, np.int32)
+        thresholds = []
+        cat_boundaries = [0]
+        cat_threshold: List[int] = []
+        cat_idx = 0
+        for i in range(nn):
+            dt = 0
+            if ht.is_categorical[i]:
+                dt |= K_CATEGORICAL_MASK
+            if ht.default_left[i]:
+                dt |= K_DEFAULT_LEFT_MASK
+            dt |= (int(ht.missing_type[i]) & 3) << 2
+            decision_type[i] = dt
+            if ht.is_categorical[i]:
+                # threshold = index into cat_boundaries (tree.h:276-291)
+                thresholds.append(str(cat_idx))
+                words = [int(w) for w in ht.cat_bitset[i]]
+                while len(words) > 1 and words[-1] == 0:
+                    words.pop()
+                cat_threshold.extend(words)
+                cat_boundaries.append(len(cat_threshold))
+                cat_idx += 1
+            else:
+                thresholds.append(_fmt(float(ht.threshold[i])))
+        lines.append("split_feature=" + _join(ht.split_feature[:nn]))
+        lines.append("split_gain=" + _join(ht.split_gain[:nn], _fmt))
+        lines.append("threshold=" + " ".join(thresholds))
+        lines.append("decision_type=" + _join(decision_type))
+        lines.append("left_child=" + _join(ht.left_child[:nn]))
+        lines.append("right_child=" + _join(ht.right_child[:nn]))
+        lines.append("leaf_value=" + _join(ht.leaf_value[:nl], _fmt))
+        lines.append("leaf_count=" + _join(ht.leaf_count[:nl]))
+        lines.append("internal_value=" + _join(ht.internal_value[:nn], _fmt))
+        lines.append("internal_count=" + _join(ht.internal_count[:nn]))
+        if num_cat > 0:
+            lines.append("cat_boundaries=" + _join(cat_boundaries))
+            lines.append("cat_threshold=" + _join(cat_threshold))
+    else:
+        lines.append("split_feature=")
+        lines.append("split_gain=")
+        lines.append("threshold=")
+        lines.append("decision_type=")
+        lines.append("left_child=")
+        lines.append("right_child=")
+        lines.append("leaf_value=" + _fmt(float(ht.leaf_value[0])))
+        lines.append("leaf_count=" + str(int(ht.leaf_count[0])))
+        lines.append("internal_value=")
+        lines.append("internal_count=")
+    lines.append("shrinkage=" + _fmt(ht.shrinkage))
+    return "\n".join(lines) + "\n\n"
+
+
+def objective_to_string(objective, config) -> str:
+    """ObjectiveFunction::ToString analogs (each objective's ToString)."""
+    if objective is None:
+        return "custom"
+    name = objective.name
+    if name == "binary":
+        return "binary sigmoid:%s" % _fmt(config.sigmoid)
+    if name == "multiclass":
+        return "multiclass num_class:%d" % config.num_class
+    if name == "multiclassova":
+        return "multiclassova num_class:%d sigmoid:%s" % (
+            config.num_class, _fmt(config.sigmoid))
+    if name == "regression" and config.reg_sqrt:
+        return "regression sqrt"
+    if name == "quantile":
+        return "quantile alpha:%s" % _fmt(config.alpha)
+    if name == "huber":
+        return "huber"
+    return name
+
+
+def model_to_string(booster, feature_names: List[str],
+                    feature_infos: List[str],
+                    num_iteration: Optional[int] = None,
+                    start_iteration: int = 0,
+                    parameters: str = "") -> str:
+    """GBDT::SaveModelToString (gbdt_model_text.cpp:244-341)."""
+    k = booster.num_tree_per_iteration
+    total_iter = len(booster.models) // max(k, 1)
+    start_iteration = min(max(start_iteration, 0), total_iter)
+    if num_iteration is not None and num_iteration > 0:
+        num_used = min((start_iteration + num_iteration) * k,
+                       len(booster.models))
+    else:
+        num_used = len(booster.models)
+    start_model = start_iteration * k
+
+    out = ["tree", "version=v2",
+           "num_class=%d" % booster.num_class,
+           "num_tree_per_iteration=%d" % k,
+           "label_index=0",
+           "max_feature_idx=%d" % (len(feature_names) - 1)]
+    out.append("objective=%s" %
+               objective_to_string(booster.objective, booster.config))
+    if booster.average_output:
+        out.append("average_output")
+    out.append("feature_names=" + " ".join(feature_names))
+    out.append("feature_infos=" + " ".join(feature_infos))
+
+    tree_strs = []
+    for idx, i in enumerate(range(start_model, num_used)):
+        tree_strs.append(tree_to_string(booster.models[i], idx))
+    out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+    out.append("")
+    body = "\n".join(out) + "\n" + "".join(tree_strs) + "end of trees\n"
+
+    # feature importances (gbdt_model_text.cpp:303-319)
+    imp = booster.feature_importance("split")
+    pairs = sorted(((imp[i], feature_names[i]) for i in range(len(imp))
+                    if i < len(feature_names) and imp[i] > 0), reverse=True)
+    body += "\nfeature importances:\n"
+    for v, name in pairs:
+        body += "%s=%d\n" % (name, int(v))
+    if parameters:
+        body += "\nparameters:\n" + parameters + "\nend of parameters\n"
+    return body
+
+
+class LoadedTree:
+    """Parsed tree block, shaped like boosting.gbdt.HostTree for prediction."""
+
+    def __init__(self, kv: Dict[str, str]):
+        nl = int(kv["num_leaves"])
+        num_cat = int(kv.get("num_cat", "0"))
+        self.num_leaves = nl
+        self.num_leaves_actual = nl
+        nn = max(nl - 1, 0)
+
+        def arr(key, dtype, n, default=0):
+            s = kv.get(key, "").strip()
+            if not s:
+                return np.full(n, default, dtype)
+            vals = np.array(s.split(" "), dtype=np.float64)
+            return vals.astype(dtype)
+
+        self.split_feature = arr("split_feature", np.int32, nn)
+        self.split_gain = arr("split_gain", np.float32, nn)
+        self.left_child = arr("left_child", np.int32, nn, -1)
+        self.right_child = arr("right_child", np.int32, nn, -1)
+        if nl > 1:
+            self.leaf_value = arr("leaf_value", np.float64, nl)
+            self.leaf_count = arr("leaf_count", np.int64, nl)
+        else:
+            self.leaf_value = np.array(
+                [float(kv.get("leaf_value", "0") or 0)], np.float64)
+            self.leaf_count = np.array(
+                [int(float(kv.get("leaf_count", "0") or 0))], np.int64)
+        self.internal_value = arr("internal_value", np.float64, nn)
+        self.internal_count = arr("internal_count", np.int64, nn)
+        self.leaf_weight = np.zeros(nl, np.float64)
+        self.internal_weight = np.zeros(nn, np.float64)
+        dt = arr("decision_type", np.int32, nn)
+        self.is_categorical = (dt & K_CATEGORICAL_MASK) > 0
+        self.default_left = (dt & K_DEFAULT_LEFT_MASK) > 0
+        self.missing_type = (dt >> 2) & 3
+        self.shrinkage = float(kv.get("shrinkage", "1"))
+
+        thr_tokens = kv.get("threshold", "").split() if nn else []
+        self.threshold = np.zeros(nn, np.float64)
+        self.threshold_bin = np.zeros(nn, np.int32)
+        self.cat_bitset = np.zeros((nn, 8), np.uint32)
+        if num_cat > 0:
+            boundaries = arr("cat_boundaries", np.int64, num_cat + 1)
+            words = arr("cat_threshold", np.int64, 0) \
+                if not kv.get("cat_threshold", "").strip() else \
+                np.array(kv["cat_threshold"].split(), np.int64)
+            for i in range(nn):
+                if self.is_categorical[i]:
+                    ci = int(float(thr_tokens[i]))
+                    w = words[boundaries[ci]:boundaries[ci + 1]]
+                    self.cat_bitset[i, :len(w)] = w.astype(np.uint32)
+        for i in range(nn):
+            if not self.is_categorical[i]:
+                self.threshold[i] = float(thr_tokens[i])
+
+        # reconstruct split_leaf for replay prediction: the leaf slot of node
+        # t is the terminal of its left-child spine (Tree::Split keeps the
+        # split leaf's index on the left child, tree.cpp:49-67)
+        self.split_leaf = np.full(nn, -1, np.int32)
+        for t in range(nn):
+            node = t
+            while True:
+                child = self.left_child[node]
+                if child < 0:
+                    self.split_leaf[t] = ~child
+                    break
+                node = child
+
+    @property
+    def num_nodes(self) -> int:
+        return self.num_leaves_actual - 1
+
+    def predict_table(self, max_nodes: int, max_leaves: int):
+        from ..core import tree as tree_mod
+        return tree_mod.pack_predict_table(self, max_nodes, max_leaves)
+
+
+def parse_model_string(model_str: str) -> Dict:
+    """GBDT::LoadModelFromString (gbdt_model_text.cpp:343+)."""
+    if "tree" not in model_str[:200]:
+        raise LightGBMError("Model format error: no 'tree' header")
+    head, _, rest = model_str.partition("Tree=")
+    kv: Dict[str, str] = {}
+    for line in head.splitlines():
+        line = line.strip()
+        if "=" in line:
+            k, _, v = line.partition("=")
+            kv[k] = v
+    trees: List[LoadedTree] = []
+    body = "Tree=" + rest if rest else ""
+    for block in body.split("Tree=")[1:]:
+        block = block.split("end of trees")[0]
+        tkv: Dict[str, str] = {}
+        for line in block.splitlines():
+            if "=" in line:
+                k, _, v = line.partition("=")
+                tkv[k.strip()] = v
+        trees.append(LoadedTree(tkv))
+
+    objective_str = kv.get("objective", "")
+    result = {
+        "num_class": int(kv.get("num_class", "1")),
+        "num_tree_per_iteration": int(kv.get("num_tree_per_iteration", "1")),
+        "max_feature_idx": int(kv.get("max_feature_idx", "0")),
+        "label_index": int(kv.get("label_index", "0")),
+        "objective": objective_str,
+        "average_output": "average_output" in head,
+        "feature_names": kv.get("feature_names", "").split(),
+        "feature_infos": kv.get("feature_infos", "").split(),
+        "trees": trees,
+    }
+    # trailing parameters block (loaded_parameter_, :492-497)
+    if "\nparameters:" in model_str:
+        params_part = model_str.split("\nparameters:", 1)[1]
+        params_part = params_part.split("end of parameters")[0]
+        result["parameters"] = params_part.strip()
+    return result
+
+
+def model_to_json(booster, feature_names: List[str],
+                  feature_infos: List[str],
+                  num_iteration: Optional[int] = None) -> str:
+    """GBDT::DumpModel JSON (gbdt_model_text.cpp:15-58, tree.cpp:242-301)."""
+    k = booster.num_tree_per_iteration
+    num_used = len(booster.models)
+    if num_iteration is not None and num_iteration > 0:
+        num_used = min(num_iteration * k, num_used)
+
+    def node_to_json(ht, index):
+        if index < 0:  # leaf
+            li = ~index
+            return {
+                "leaf_index": int(li),
+                "leaf_value": float(ht.leaf_value[li]),
+                "leaf_count": int(ht.leaf_count[li]),
+            }
+        d = {
+            "split_index": int(index),
+            "split_feature": int(ht.split_feature[index]),
+            "split_gain": float(ht.split_gain[index]),
+            "threshold": float(ht.threshold[index]),
+            "decision_type": "==" if ht.is_categorical[index] else "<=",
+            "default_left": bool(ht.default_left[index]),
+            "missing_type": _MISSING_NAMES.get(int(ht.missing_type[index]),
+                                               "None"),
+            "internal_value": float(ht.internal_value[index]),
+            "internal_count": int(ht.internal_count[index]),
+            "left_child": node_to_json(ht, int(ht.left_child[index])),
+            "right_child": node_to_json(ht, int(ht.right_child[index])),
+        }
+        return d
+
+    trees = []
+    for i in range(num_used):
+        ht = booster.models[i]
+        t = {"tree_index": i,
+             "num_leaves": int(ht.num_leaves_actual),
+             "shrinkage": float(ht.shrinkage)}
+        if ht.num_leaves_actual <= 1:
+            t["tree_structure"] = {"leaf_value": float(ht.leaf_value[0])}
+        else:
+            t["tree_structure"] = node_to_json(ht, 0)
+        trees.append(t)
+
+    return json.dumps({
+        "name": "tree",
+        "version": "v2",
+        "num_class": booster.num_class,
+        "num_tree_per_iteration": k,
+        "label_index": 0,
+        "max_feature_idx": len(feature_names) - 1,
+        "objective": objective_to_string(booster.objective, booster.config),
+        "average_output": booster.average_output,
+        "feature_names": feature_names,
+        "feature_infos": feature_infos,
+        "tree_info": trees,
+    }, indent=2)

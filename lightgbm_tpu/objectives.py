@@ -52,6 +52,27 @@ class ObjectiveFunction:
             return grad * self.weights, hess * self.weights
         return grad, hess
 
+    def pad_to(self, num_rows: int, mesh=None) -> None:
+        """Pad per-row arrays for even mesh sharding (padded rows are masked
+        out of every histogram/sum by the driver's row_valid mask; gradients
+        computed on them are never used). Every jnp attribute of length
+        num_data is treated as per-row (label, weights, trans_label,
+        label_weight, ...)."""
+        n0 = self.label.shape[0]
+        pad = num_rows - n0
+        sh = None
+        if mesh is not None:
+            from .parallel.mesh import row_sharding
+            sh = row_sharding(mesh)
+        for name, val in list(self.__dict__.items()):
+            if isinstance(val, jnp.ndarray) and val.ndim == 1 \
+                    and val.shape[0] == n0:
+                if pad > 0:
+                    val = jnp.concatenate([val, jnp.zeros((pad,), val.dtype)])
+                if sh is not None:
+                    val = jax.device_put(val, sh)
+                setattr(self, name, val)
+
     def get_gradients(self, score: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         raise NotImplementedError
 

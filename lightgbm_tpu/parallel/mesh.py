@@ -1,0 +1,95 @@
+"""Device mesh construction + sharding helpers.
+
+The reference's distribution model (tree_learner=serial/feature/data/voting ×
+num_machines, config.h:177,748) maps onto a jax.sharding.Mesh:
+
+- ``data`` axis: rows sharded (DataParallelTreeLearner analog). Histograms
+  built from row shards are combined by XLA-inserted all-reduces under GSPMD
+  (the ReduceScatter of data_parallel_tree_learner.cpp:146-161 becomes a
+  compiler-inserted collective).
+- ``feature`` axis: feature columns sharded (FeatureParallelTreeLearner
+  analog); per-feature split search shards naturally, the global argmax is
+  the SyncUpGlobalBestSplit (parallel_tree_learner.h:186) analog.
+- voting-parallel uses the explicit shard_map path (learners.py) because its
+  comm compression (top-k vote, then reduce only elected features,
+  voting_parallel_tree_learner.cpp:166-360) is a manual optimization GSPMD
+  cannot infer.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..log import Log, LightGBMError
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def build_mesh(config: Config, devices=None) -> Optional[Mesh]:
+    """Build the training mesh from config (mesh_shape / tree_learner).
+
+    Returns None for single-device serial training (the common case on one
+    chip) — everything then runs unsharded.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if config.mesh_shape:
+        shape = tuple(int(s) for s in config.mesh_shape)
+        total = int(np.prod(shape))
+        if total > n:
+            raise LightGBMError(
+                "mesh_shape %s needs %d devices, only %d available"
+                % (shape, total, n))
+        devs = np.asarray(devices[:total])
+        if len(shape) == 1:
+            axis = (FEATURE_AXIS if config.tree_learner == "feature"
+                    else DATA_AXIS)
+            return Mesh(devs.reshape(shape), (axis,))
+        return Mesh(devs.reshape(shape), (DATA_AXIS, FEATURE_AXIS))
+    if config.tree_learner != "serial" and n > 1:
+        axis = (FEATURE_AXIS if config.tree_learner == "feature"
+                else DATA_AXIS)
+        return Mesh(np.asarray(devices), (axis,))
+    return None
+
+
+def row_sharding(mesh: Optional[Mesh], extra_dims: int = 0):
+    """Sharding for [N, ...] arrays: rows over the data axis."""
+    if mesh is None:
+        return None
+    spec = [DATA_AXIS if DATA_AXIS in mesh.axis_names else None]
+    spec += [None] * extra_dims
+    return NamedSharding(mesh, P(*spec))
+
+
+def feature_sharding(mesh: Optional[Mesh]):
+    """Sharding for [N, F] bin matrices in feature-parallel mode."""
+    if mesh is None:
+        return None
+    if FEATURE_AXIS in mesh.axis_names:
+        row = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+        return NamedSharding(mesh, P(row, FEATURE_AXIS))
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def replicated(mesh: Optional[Mesh]):
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(mesh: Optional[Mesh], *arrays):
+    """device_put [N, ...] arrays with rows over the data axis, padding not
+    required (jax shards uneven remainders automatically)."""
+    if mesh is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = []
+    for a in arrays:
+        sh = row_sharding(mesh, extra_dims=a.ndim - 1)
+        out.append(jax.device_put(a, sh))
+    return tuple(out) if len(out) > 1 else out[0]

@@ -1,0 +1,67 @@
+"""Distributed topology bootstrap.
+
+The reference's Network/Linkers stack (src/network/: TCP mesh construction,
+Bruck allgather, recursive-halving reduce-scatter — network.cpp:64-298) is
+replaced wholesale by XLA collectives over the device mesh: psum/all_gather/
+reduce_scatter compiled into the training step (see parallel.learners).
+What remains host-side is multi-process bootstrap: the analog of
+Network::Init (application.cpp:169) is ``jax.distributed.initialize``.
+
+``init`` accepts the reference's ``machines`` ip:port list for API compat
+(basic.py:1734 set_network) and maps it onto jax.distributed's
+coordinator/process model.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..log import Log, LightGBMError
+
+_initialized = False
+_num_machines = 1
+_rank = 0
+
+
+def init(machines: str = "", local_listen_port: int = 12400,
+         time_out: int = 120, num_machines: int = 1) -> None:
+    """Network::Init analog. With num_machines == 1 this is a no-op; with
+    more, the caller must run one process per host and the machine list's
+    first entry is used as the jax.distributed coordinator."""
+    global _initialized, _num_machines, _rank
+    if num_machines <= 1:
+        _initialized = True
+        return
+    import jax
+    hosts: List[str] = [m.strip() for m in machines.split(",") if m.strip()]
+    if len(hosts) != num_machines:
+        raise LightGBMError(
+            "machines list has %d entries but num_machines=%d"
+            % (len(hosts), num_machines))
+    coordinator = hosts[0]
+    process_id = int(os.environ.get("LIGHTGBM_TPU_RANK",
+                                    os.environ.get("JAX_PROCESS_ID", "0")))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_machines,
+                               process_id=process_id,
+                               initialization_timeout=time_out)
+    _initialized = True
+    _num_machines = num_machines
+    _rank = process_id
+    Log.info("Distributed init: rank %d / %d (coordinator %s)",
+             _rank, _num_machines, coordinator)
+
+
+def free() -> None:
+    global _initialized, _num_machines, _rank
+    _initialized = False
+    _num_machines = 1
+    _rank = 0
+
+
+def num_machines() -> int:
+    return _num_machines
+
+
+def rank() -> int:
+    return _rank

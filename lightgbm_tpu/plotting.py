@@ -1,0 +1,226 @@
+"""Plotting utilities.
+
+Reference: python-package/lightgbm/plotting.py — plot_importance (:30),
+plot_metric (:144), plot_tree / create_tree_digraph (:318). matplotlib and
+graphviz are optional; informative errors otherwise (compat.py pattern).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .log import LightGBMError
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError("%s must be a tuple of 2 elements." % obj_name)
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None, title="Feature importance",
+                    xlabel="Feature importance", ylabel="Features",
+                    importance_type: str = "split", max_num_features=None,
+                    ignore_zero: bool = True, figsize=None, grid: bool = True,
+                    precision: Optional[int] = 3, **kwargs):
+    """plotting.py:30."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot importance")
+
+    if isinstance(booster, Booster):
+        importance = booster.feature_importance(importance_type)
+        feature_name = booster.feature_name()
+    elif hasattr(booster, "booster_"):
+        importance = booster.booster_.feature_importance(importance_type)
+        feature_name = booster.booster_.feature_name()
+    else:
+        raise TypeError("booster must be Booster or LGBMModel")
+
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty")
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples)
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                ("%." + str(precision) + "f") % x if precision is not None
+                and importance_type == "gain" else str(int(x)), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None, title="Metric during training",
+                xlabel="Iterations", ylabel="auto", figsize=None,
+                grid: bool = True):
+    """plotting.py:144: plot recorded eval history (record_evaluation dict or
+    a fitted LGBMModel)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot metric")
+
+    if isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif hasattr(booster, "evals_result_"):
+        eval_results = deepcopy(booster.evals_result_)
+    else:
+        raise TypeError("booster must be dict or LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty")
+
+    if dataset_names is None:
+        dataset_names = list(eval_results.keys())
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+
+    name = dataset_names[0]
+    metrics_for_one = eval_results[name]
+    if metric is None:
+        if len(metrics_for_one) > 1:
+            raise ValueError("more than one metric available, pick one")
+        metric, results = list(metrics_for_one.items())[0]
+    else:
+        if metric not in metrics_for_one:
+            raise ValueError("specific metric not found")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result, min_result = max(results), min(results)
+    for name in dataset_names:
+        results = eval_results[name][metric]
+        max_result = max(max(results), max_result)
+        min_result = min(min(results), min_result)
+        ax.plot(range(num_iteration), results, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    else:
+        range_result = max_result - min_result
+        ax.set_ylim(min_result - range_result * 0.2,
+                    max_result + range_result * 0.2)
+    if ylabel == "auto":
+        ylabel = metric
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _to_graphviz(tree_info: Dict, show_info: List[str],
+                 feature_names: List[str], precision=3, **kwargs):
+    """plotting.py:244 _to_graphviz."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree")
+
+    def add(root, parent=None, decision=None):
+        if "split_index" in root:
+            name = "split%d" % root["split_index"]
+            f = root["split_feature"]
+            label = feature_names[f] if feature_names else "feature %d" % f
+            label += " %s %s" % (root.get("decision_type", "<="),
+                                 round(root["threshold"], precision)
+                                 if isinstance(root["threshold"], float)
+                                 else root["threshold"])
+            for info in show_info:
+                if info in ("split_gain", "internal_value"):
+                    label += "\n%s: %s" % (info, round(root[info], precision))
+                elif info == "internal_count":
+                    label += "\ncount: %d" % root[info]
+            graph.node(name, label=label)
+            add(root["left_child"], name, "yes")
+            add(root["right_child"], name, "no")
+        else:
+            name = "leaf%d" % root["leaf_index"]
+            label = "leaf %d: %s" % (root["leaf_index"],
+                                     round(root["leaf_value"], precision))
+            if "leaf_count" in show_info:
+                label += "\ncount: %d" % root["leaf_count"]
+            graph.node(name, label=label)
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    graph = Digraph(**kwargs)
+    add(tree_info["tree_structure"])
+    return graph
+
+
+def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
+                        precision: Optional[int] = 3, **kwargs):
+    """plotting.py:318."""
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel")
+    model = booster.dump_model()
+    tree_infos = model["tree_info"]
+    feature_names = model.get("feature_names", None)
+    if tree_index >= len(tree_infos):
+        raise IndexError("tree_index is out of range")
+    if show_info is None:
+        show_info = []
+    return _to_graphviz(tree_infos[tree_index], show_info, feature_names,
+                        precision, **kwargs)
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None,
+              show_info=None, precision: Optional[int] = 3, **kwargs):
+    """plotting.py:390s: render via graphviz into a matplotlib axis."""
+    try:
+        import matplotlib.pyplot as plt
+        import matplotlib.image as image
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot tree")
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    graph = create_tree_digraph(booster, tree_index, show_info, precision,
+                                **kwargs)
+    from io import BytesIO
+    s = BytesIO(graph.pipe(format="png"))
+    img = image.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

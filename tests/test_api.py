@@ -1,0 +1,192 @@
+"""Python API tests (reference: tests/python_package_test/test_basic.py,
+test_engine.py)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_binary, make_regression, make_multiclass, make_ranking
+
+
+def test_train_basic_binary():
+    X, y = make_binary()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "metric": "auc", "verbosity": -1},
+                    train, num_boost_round=20)
+    pred = bst.predict(X)
+    assert pred.shape == (len(y),)
+    assert ((pred >= 0) & (pred <= 1)).all()
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, pred) > 0.95
+
+
+def test_train_with_valid_and_evals_result():
+    X, y = make_binary(n=1500)
+    Xv, yv = make_binary(n=500, seed=99)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": ["auc", "binary_logloss"],
+                     "verbosity": -1},
+                    train, num_boost_round=10, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    assert "valid_0" in evals
+    assert "auc" in evals["valid_0"]
+    assert len(evals["valid_0"]["auc"]) == 10
+
+
+def test_early_stopping():
+    X, y = make_binary(n=1500)
+    Xv, yv = make_binary(n=500, seed=99)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "learning_rate": 0.5, "num_leaves": 63, "verbosity": -1},
+                    train, num_boost_round=200, valid_sets=[valid],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.best_iteration < 200
+    assert "binary_logloss" in bst.best_score["valid_0"]
+
+
+def test_save_load_predict_roundtrip(tmp_path):
+    X, y = make_regression()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, train,
+                    num_boost_round=10)
+    p1 = bst.predict(X[:100])
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    p2 = bst2.predict(X[:100])
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    # model_to_string round trip
+    bst3 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(p1, bst3.predict(X[:100]), rtol=1e-5, atol=1e-6)
+
+
+def test_dump_model_json():
+    X, y = make_binary(n=600)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    d = bst.dump_model()
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    assert "tree_structure" in d["tree_info"][0]
+
+
+def test_custom_fobj_feval():
+    X, y = make_regression()
+    train = lgb.Dataset(X, label=y)
+
+    def l2_obj(preds, dataset):
+        grad = preds - dataset.get_label()
+        hess = np.ones_like(grad)
+        return grad, hess
+
+    def l1_eval(preds, dataset):
+        return "mae", float(np.mean(np.abs(preds - dataset.get_label()))), False
+
+    evals = {}
+    bst = lgb.train({"verbosity": -1, "learning_rate": 0.2}, train,
+                    num_boost_round=30, fobj=l2_obj, feval=l1_eval,
+                    valid_sets=[train], valid_names=["training"],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["training"]["mae"][-1] < evals["training"]["mae"][0]
+
+
+def test_continue_training_from_init_model(tmp_path):
+    X, y = make_regression()
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst1 = lgb.train({"objective": "regression", "verbosity": -1}, train,
+                     num_boost_round=5)
+    mse1 = float(np.mean((bst1.predict(X) - y) ** 2))
+    train2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst2 = lgb.train({"objective": "regression", "verbosity": -1}, train2,
+                     num_boost_round=5, init_model=bst1)
+    mse2 = float(np.mean(
+        (bst2.predict(X) + bst1.predict(X) - y) ** 2))
+    assert mse2 < mse1
+
+
+def test_cv():
+    X, y = make_binary(n=1200)
+    res = lgb.cv({"objective": "binary", "metric": "auc", "verbosity": -1},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=5, nfold=3, stratified=True)
+    assert "valid auc-mean" in res
+    assert len(res["valid auc-mean"]) == 5
+    assert res["valid auc-mean"][-1] > 0.85
+
+
+def test_shap_contribs_sum_to_raw_score():
+    X, y = make_binary(n=400, f=6)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    contribs = bst.predict(X[:20], pred_contrib=True)
+    raw = bst.predict(X[:20], raw_score=True)
+    assert contribs.shape == (20, X.shape[1] + 1)
+    np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+
+def test_pred_leaf_shape():
+    X, y = make_binary(n=500)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    leaves = bst.predict(X[:50], pred_leaf=True)
+    assert leaves.shape == (50, 4)
+    assert leaves.dtype in (np.int32, np.int64)
+
+
+def test_feature_importance_api():
+    X, y = make_binary()
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    imp = bst.feature_importance()
+    assert imp.dtype == np.int64
+    assert imp.sum() > 0
+    impg = bst.feature_importance("gain")
+    assert impg.sum() > 0
+
+
+def test_dataset_fields_and_names():
+    X, y = make_binary(n=300)
+    w = np.random.rand(300)
+    ds = lgb.Dataset(X, label=y, weight=w,
+                     feature_name=["f%d" % i for i in range(X.shape[1])])
+    ds.construct()
+    np.testing.assert_allclose(ds.get_label(), y, rtol=1e-6)
+    np.testing.assert_allclose(ds.get_weight(), w, rtol=1e-6)
+    assert ds.num_data() == 300
+    assert ds.num_feature() == X.shape[1]
+    assert ds.get_feature_name()[0] == "f0"
+
+
+def test_ranking_through_api():
+    X, y, group = make_ranking()
+    train = lgb.Dataset(X, label=y, group=group)
+    evals = {}
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "eval_at": [5], "verbosity": -1},
+                    train, num_boost_round=10, valid_sets=[train],
+                    valid_names=["training"], evals_result=evals,
+                    verbose_eval=False)
+    assert evals["training"]["ndcg@5"][-1] > evals["training"]["ndcg@5"][0] - 1e-9
+
+
+def test_multiclass_through_api():
+    X, y = make_multiclass(k=3)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    pred = bst.predict(X[:100])
+    assert pred.shape == (100, 3)
+    np.testing.assert_allclose(pred.sum(1), 1.0, rtol=1e-4)
+
+
+def test_learning_rates_schedule():
+    X, y = make_regression(n=800)
+    bst = lgb.train({"objective": "regression", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    learning_rates=lambda i: 0.3 * (0.5 ** i))
+    assert bst.current_iteration == 6

@@ -1,0 +1,134 @@
+"""BinMapper unit tests (reference behavior: src/io/bin.cpp FindBin,
+bin.h:457-493 ValueToBin)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.binning import BinMapper, BinType, MissingType
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _find(values, total=None, max_bin=255, **kw):
+    m = BinMapper()
+    values = np.asarray(values, np.float64)
+    kw.setdefault("min_data_in_bin", 1)
+    kw.setdefault("min_split_data", 1)
+    m.find_bin(values, total_sample_cnt=total or len(values), max_bin=max_bin,
+               **kw)
+    return m
+
+
+def test_simple_numerical_bins_partition_values():
+    vals = np.arange(100, dtype=np.float64)
+    m = _find(vals, max_bin=10)
+    bins = m.values_to_bins(vals)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # binning must be monotone in the raw value
+    assert (np.diff(bins) >= 0).all()
+
+
+def test_distinct_few_values_get_own_bins():
+    vals = np.array([1.0, 2.0, 3.0] * 50)
+    m = _find(vals)
+    b = m.values_to_bins(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+
+
+def test_trivial_feature():
+    m = _find(np.full(100, 5.0), use_missing=False)
+    assert m.is_trivial or m.num_bin <= 1
+
+
+def test_nan_goes_to_last_bin():
+    vals = np.concatenate([np.arange(50, dtype=np.float64),
+                           np.full(10, np.nan)])
+    m = _find(vals, use_missing=True)
+    assert m.missing_type == MissingType.NAN
+    b = m.values_to_bins(np.array([np.nan]))
+    assert b[0] == m.num_bin - 1
+
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.arange(1, 51, dtype=np.float64),
+                           np.zeros(30)])
+    m = _find(vals, use_missing=True, zero_as_missing=True)
+    assert m.missing_type == MissingType.ZERO
+
+
+def test_bin_to_value_roundtrip_monotone():
+    r = np.random.RandomState(3)
+    vals = r.randn(1000)
+    m = _find(vals, max_bin=64)
+    uppers = [m.bin_to_value(i) for i in range(m.num_bin)]
+    # upper bounds must be increasing over numerical bins
+    nb = m.num_bin - (1 if m.missing_type == MissingType.NAN else 0)
+    assert all(uppers[i] <= uppers[i + 1] for i in range(nb - 2))
+
+
+def test_value_to_bin_respects_boundaries():
+    vals = np.array([0.0, 1.0, 2.0, 3.0, 4.0] * 20)
+    m = _find(vals)
+    for v in [0.0, 1.0, 2.0, 3.0, 4.0]:
+        b = int(m.values_to_bins(np.array([v]))[0])
+        # upper bound of the assigned bin must be >= the value
+        assert m.bin_upper_bound[b] >= v
+
+
+def test_categorical_binning():
+    vals = np.array([0, 1, 2, 1, 0, 2, 5, 5, 5, 1] * 20, np.float64)
+    m = _find(vals, bin_type=BinType.CATEGORICAL)
+    assert m.bin_type == BinType.CATEGORICAL
+    b = m.values_to_bins(np.array([0.0, 1.0, 2.0, 5.0]))
+    assert len(set(b.tolist())) == 4
+    # unseen category maps to bin 0 (reference: ValueToBin returns 0)
+    unseen = m.values_to_bins(np.array([99.0]))
+    assert unseen[0] == 0
+
+
+def test_equal_count_binning_balances_counts():
+    r = np.random.RandomState(0)
+    vals = r.exponential(size=10000)
+    m = _find(vals, max_bin=16)
+    bins = m.values_to_bins(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    nb = m.num_bin
+    # greedy equal-count: no bin (except possibly tail) wildly imbalanced
+    assert counts.max() < len(vals) / nb * 4
+
+
+def test_dataset_from_matrix_shapes():
+    r = np.random.RandomState(1)
+    X = r.randn(500, 8)
+    X[:, 3] = 1.0  # trivial column dropped
+    cfg = Config({"max_bin": 63, "min_data_in_bin": 1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=np.zeros(500))
+    assert ds.num_total_features == 8
+    assert ds.num_features == 7
+    assert ds.X_binned.shape == (500, 7)
+    assert ds.X_binned.dtype == np.uint8
+    assert ds.max_num_bin() <= 63 + 1  # + NaN bin headroom
+
+
+def test_dataset_reference_alignment():
+    r = np.random.RandomState(2)
+    X = r.randn(300, 5)
+    cfg = Config({})
+    ds = BinnedDataset.from_matrix(X, cfg, label=np.zeros(300))
+    X2 = r.randn(100, 5)
+    ds2 = BinnedDataset.from_matrix(X2, cfg, label=np.zeros(100), reference=ds)
+    assert ds2.bin_mappers is ds.bin_mappers
+    assert ds2.X_binned.shape[1] == ds.X_binned.shape[1]
+
+
+def test_binary_cache_roundtrip(tmp_path):
+    r = np.random.RandomState(4)
+    X = r.randn(200, 4)
+    y = r.rand(200)
+    cfg = Config({})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y, weight=np.ones(200))
+    p = str(tmp_path / "ds.npz")
+    ds.save_binary(p)
+    ds2 = BinnedDataset.load_binary(p)
+    np.testing.assert_array_equal(ds.X_binned, ds2.X_binned)
+    np.testing.assert_allclose(ds.metadata.label, ds2.metadata.label)
+    assert ds2.num_total_features == 4

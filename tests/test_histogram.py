@@ -1,0 +1,89 @@
+"""Histogram kernel properties (reference: dense_bin.hpp ConstructHistogram,
+dataset.h FixHistogram; SURVEY.md §4 property tests)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.core.histogram import (build_histogram, fix_histogram,
+                                         subtract_histogram)
+
+
+def _ref_hist(xb, g, h, mask, b):
+    n, f = xb.shape
+    out = np.zeros((f, b, 3), np.float64)
+    for i in range(n):
+        if mask[i] == 0:
+            continue
+        for j in range(f):
+            out[j, xb[i, j], 0] += g[i]
+            out[j, xb[i, j], 1] += h[i]
+            out[j, xb[i, j], 2] += 1
+    return out
+
+
+@pytest.mark.parametrize("impl", ["matmul", "scatter"])
+def test_histogram_matches_reference_loop(impl):
+    r = np.random.RandomState(0)
+    n, f, b = 500, 6, 16
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = r.rand(n).astype(np.float32)
+    mask = (r.rand(n) < 0.7).astype(np.float32)
+    hist = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                      jnp.asarray(h), jnp.asarray(mask),
+                                      num_bins=b, impl=impl))
+    ref = _ref_hist(xb, g, h, mask, b)
+    np.testing.assert_allclose(hist, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_chunked_equals_unchunked():
+    r = np.random.RandomState(1)
+    n, f, b = 70000, 4, 32
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = r.rand(n).astype(np.float32)
+    mask = np.ones(n, np.float32)
+    h1 = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                    jnp.asarray(h), jnp.asarray(mask),
+                                    num_bins=b, row_chunk=16384))
+    h2 = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                    jnp.asarray(h), jnp.asarray(mask),
+                                    num_bins=b, row_chunk=200000))
+    np.testing.assert_allclose(h1, h2, rtol=1e-3, atol=1e-2)
+
+
+def test_subtraction_consistency():
+    """SURVEY §4: child = parent - sibling must hold exactly in f32."""
+    r = np.random.RandomState(2)
+    n, f, b = 2000, 5, 16
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = r.rand(n).astype(np.float32)
+    left = (r.rand(n) < 0.5).astype(np.float32)
+    parent = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                        jnp.asarray(h),
+                                        jnp.ones(n, np.float32), num_bins=b))
+    hl = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                    jnp.asarray(h), jnp.asarray(left),
+                                    num_bins=b))
+    hr = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                    jnp.asarray(h), jnp.asarray(1 - left),
+                                    num_bins=b))
+    np.testing.assert_allclose(
+        np.asarray(subtract_histogram(jnp.asarray(parent), jnp.asarray(hl))),
+        hr, rtol=1e-3, atol=1e-2)
+
+
+def test_fix_histogram_restores_totals():
+    r = np.random.RandomState(3)
+    f, b = 4, 16
+    hist = r.rand(f, b, 3).astype(np.float32)
+    default_bins = np.array([0, 3, 5, 15], np.int32)
+    sg, sh, cnt = 100.0, 50.0, 1000.0
+    fixed = np.asarray(fix_histogram(jnp.asarray(hist),
+                                     jnp.asarray(default_bins),
+                                     jnp.float32(sg), jnp.float32(sh),
+                                     jnp.float32(cnt)))
+    np.testing.assert_allclose(fixed[:, :, 0].sum(1), sg, rtol=1e-5)
+    np.testing.assert_allclose(fixed[:, :, 1].sum(1), sh, rtol=1e-5)
+    np.testing.assert_allclose(fixed[:, :, 2].sum(1), cnt, rtol=1e-5)

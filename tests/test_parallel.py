@@ -1,0 +1,145 @@
+"""Multi-device training on the virtual 8-device CPU mesh (SURVEY.md §4:
+the tests the reference never had — distributed paths exercised without a
+cluster)."""
+import numpy as np
+import jax
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.parallel import mesh as mesh_mod
+
+from conftest import make_binary
+
+
+def _train(params, X, y, rounds=8):
+    cfg = Config(params)
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg),
+                        [create_metric("auc", cfg)])
+    for _ in range(rounds):
+        if b.train_one_iter():
+            break
+    return b
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_build_mesh_shapes():
+    cfg = Config({"tree_learner": "data"})
+    m = mesh_mod.build_mesh(cfg)
+    assert m is not None and m.shape["data"] == 8
+    cfg = Config({"tree_learner": "feature"})
+    m = mesh_mod.build_mesh(cfg)
+    assert m is not None and m.shape["feature"] == 8
+    cfg = Config({"mesh_shape": [4]})
+    m = mesh_mod.build_mesh(cfg)
+    assert m.shape["data"] == 4
+    cfg = Config({})
+    assert mesh_mod.build_mesh(cfg) is None
+
+
+def test_data_parallel_matches_serial():
+    """Data-parallel (rows sharded over 8 devices) must reproduce serial
+    results: histograms are f32 sums so allow tiny drift
+    (data_parallel_tree_learner.cpp semantics via GSPMD)."""
+    X, y = make_binary(n=2000)
+    serial = _train({"objective": "binary", "metric": "auc",
+                     "verbosity": -1}, X, y)
+    dp = _train({"objective": "binary", "metric": "auc",
+                 "tree_learner": "data", "verbosity": -1}, X, y)
+    auc_s = dict((m, v) for _, m, v, _ in serial.get_eval_at(0))["auc"]
+    auc_d = dict((m, v) for _, m, v, _ in dp.get_eval_at(0))["auc"]
+    assert abs(auc_s - auc_d) < 1e-3
+    ps = serial.predict(X[:200], raw_score=True)
+    pd = dp.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(ps, pd, rtol=1e-3, atol=1e-3)
+
+
+def test_data_parallel_uneven_rows():
+    """Row count not divisible by 8: padding must not change results."""
+    X, y = make_binary(n=2005)  # 2005 % 8 != 0
+    dp = _train({"objective": "binary", "metric": "auc",
+                 "tree_learner": "data", "verbosity": -1}, X, y, rounds=5)
+    auc = dict((m, v) for _, m, v, _ in dp.get_eval_at(0))["auc"]
+    assert auc > 0.9
+    # leaf counts must total the real (unpadded) row count
+    t = dp.models[0]
+    assert int(t.leaf_count[:t.num_leaves_actual].sum()) == 2005
+
+
+def test_feature_parallel_matches_serial():
+    X, y = make_binary(n=1500)
+    serial = _train({"objective": "binary", "metric": "auc",
+                     "verbosity": -1}, X, y, rounds=5)
+    fp = _train({"objective": "binary", "metric": "auc",
+                 "tree_learner": "feature", "verbosity": -1}, X, y, rounds=5)
+    auc_s = dict((m, v) for _, m, v, _ in serial.get_eval_at(0))["auc"]
+    auc_f = dict((m, v) for _, m, v, _ in fp.get_eval_at(0))["auc"]
+    assert abs(auc_s - auc_f) < 1e-3
+
+
+def test_data_parallel_through_python_api():
+    X, y = make_binary(n=1600)
+    bst = lgb.train({"objective": "binary", "tree_learner": "data",
+                     "metric": "auc", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_grow_tree_explicit_psum_path():
+    """The shard_map/axis_name path in grow_tree (manual collectives used by
+    the voting learner) matches the unsharded result."""
+    from functools import partial
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from lightgbm_tpu.core.grow import grow_tree, GrowParams
+    from lightgbm_tpu.core.split import SplitParams, FeatureMeta
+
+    r = np.random.RandomState(0)
+    n, f, b = 512, 6, 16
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    meta = FeatureMeta(
+        num_bin=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        penalty=jnp.ones((f,), jnp.float32))
+    sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, max_delta_step=0.0,
+                     min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
+                     min_gain_to_split=0.0, max_cat_threshold=32,
+                     cat_smooth=10.0, cat_l2=10.0, max_cat_to_onehot=4,
+                     min_data_per_group=100)
+    params = GrowParams(num_leaves=15, num_bins=b, max_depth=-1, split=sp,
+                        row_chunk=16384, hist_impl="scatter")
+    ones = np.ones(n, np.float32)
+    fmask = jnp.ones((f,), bool)
+
+    tree_ref, leaf_ref = jax.jit(
+        lambda xbj, gj, hj, mj: grow_tree(xbj, gj, hj, mj, meta, fmask,
+                                          params))(xb, g, h, ones)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    fn = shard_map(
+        lambda xbj, gj, hj, mj: grow_tree(xbj, gj, hj, mj, meta, fmask,
+                                          params, axis_name="data"),
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P("data")),
+        out_specs=(jax.tree.map(lambda _: P(), tree_ref), P("data")))
+    tree_dp, leaf_dp = jax.jit(fn)(xb, g, h, ones)
+
+    assert int(tree_dp.num_leaves) == int(tree_ref.num_leaves)
+    np.testing.assert_array_equal(np.asarray(leaf_dp), np.asarray(leaf_ref))
+    np.testing.assert_allclose(np.asarray(tree_dp.leaf_value),
+                               np.asarray(tree_ref.leaf_value),
+                               rtol=1e-4, atol=1e-5)

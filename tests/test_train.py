@@ -1,0 +1,217 @@
+"""End-to-end training quality tests through the GBDT driver (the reference's
+test_engine.py style: train, assert metric threshold)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.boosting import create_boosting
+
+from conftest import make_binary, make_regression, make_multiclass, make_ranking
+
+
+def _train(X, y, params, rounds=30, group=None, weight=None):
+    cfg = Config(params)
+    ds = BinnedDataset.from_matrix(X, cfg, label=y, group=group, weight=weight)
+    obj = create_objective(cfg)
+    metric_names = cfg.metric or []
+    mets = [m for m in (create_metric(n, cfg) for n in metric_names) if m]
+    booster = create_boosting(cfg, ds, obj, mets)
+    for _ in range(rounds):
+        if booster.train_one_iter():
+            break
+    return booster, ds
+
+
+def test_binary_auc():
+    X, y = make_binary()
+    b, _ = _train(X, y, {"objective": "binary", "num_leaves": 31,
+                         "metric": "auc", "verbosity": -1})
+    res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+    assert res["auc"] > 0.95
+
+
+def test_binary_predict_matches_train_scores():
+    X, y = make_binary(n=1000)
+    b, ds = _train(X, y, {"objective": "binary", "verbosity": -1}, rounds=10)
+    pred = b.predict(X, raw_score=True)
+    train_scores = np.asarray(b.scores)[:, 0]
+    np.testing.assert_allclose(pred, train_scores, rtol=1e-4, atol=1e-4)
+
+
+def test_regression_l2():
+    X, y = make_regression()
+    b, _ = _train(X, y, {"objective": "regression", "metric": "l2",
+                         "verbosity": -1}, rounds=50)
+    res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+    assert res["l2"] < 0.5
+
+
+def test_regression_l1_renews_leaves():
+    X, y = make_regression()
+    b, _ = _train(X, y, {"objective": "regression_l1", "metric": "l1",
+                         "verbosity": -1}, rounds=50)
+    res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+    assert res["l1"] < 0.6
+
+
+def test_multiclass():
+    X, y = make_multiclass(k=4)
+    b, _ = _train(X, y, {"objective": "multiclass", "num_class": 4,
+                         "metric": "multi_logloss", "verbosity": -1},
+                  rounds=30)
+    res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+    assert res["multi_logloss"] < 0.4
+    pred = b.predict(X)
+    assert pred.shape == (len(y), 4)
+    np.testing.assert_allclose(pred.sum(1), 1.0, rtol=1e-4)
+    acc = (pred.argmax(1) == y).mean()
+    assert acc > 0.85
+
+
+def test_lambdarank_ndcg_improves():
+    X, y, group = make_ranking()
+    b, _ = _train(X, y, {"objective": "lambdarank", "metric": "ndcg",
+                         "eval_at": [5], "verbosity": -1},
+                  rounds=30, group=group)
+    res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+    assert res["ndcg@5"] > 0.80
+
+
+def test_weights_affect_training():
+    X, y = make_binary(n=1000)
+    w = np.where(y > 0, 10.0, 1.0)
+    b, _ = _train(X, y, {"objective": "binary", "verbosity": -1},
+                  rounds=10, weight=w)
+    pred = b.predict(X)
+    # heavy positive weight → predicted probabilities skew up
+    assert pred.mean() > y.mean()
+
+
+def test_bagging_and_feature_fraction():
+    X, y = make_binary()
+    b, _ = _train(X, y, {"objective": "binary", "metric": "auc",
+                         "bagging_fraction": 0.6, "bagging_freq": 1,
+                         "feature_fraction": 0.7, "verbosity": -1})
+    res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+    assert res["auc"] > 0.92
+
+
+def test_min_data_in_leaf_respected():
+    X, y = make_binary(n=500)
+    b, _ = _train(X, y, {"objective": "binary", "min_data_in_leaf": 50,
+                         "verbosity": -1}, rounds=5)
+    for t in b.models:
+        cnt = t.leaf_count[:t.num_leaves_actual]
+        assert (cnt >= 50).all()
+
+
+def test_max_depth_respected():
+    X, y = make_binary()
+    b, _ = _train(X, y, {"objective": "binary", "max_depth": 3,
+                         "num_leaves": 31, "verbosity": -1}, rounds=5)
+    for t in b.models:
+        # depth-3 tree has at most 8 leaves
+        assert t.num_leaves_actual <= 8
+
+
+def test_monotone_constraints():
+    r = np.random.RandomState(0)
+    n = 2000
+    X = r.rand(n, 2)
+    y = 2 * X[:, 0] + np.sin(6 * X[:, 1]) + 0.1 * r.randn(n)
+    b, _ = _train(X, y, {"objective": "regression",
+                         "monotone_constraints": [1, 0],
+                         "verbosity": -1}, rounds=40)
+    # brute-force monotonicity check (reference test_engine.py:680)
+    grid = np.tile(np.linspace(0.01, 0.99, 50)[:, None], (1, 2))
+    grid[:, 1] = 0.5
+    pred = b.predict(grid)
+    assert (np.diff(pred) >= -1e-6).all()
+
+
+def test_rollback_one_iter():
+    X, y = make_binary(n=800)
+    b, _ = _train(X, y, {"objective": "binary", "verbosity": -1}, rounds=5)
+    scores_before = np.asarray(b.scores).copy()
+    b.train_one_iter()
+    b.rollback_one_iter()
+    np.testing.assert_allclose(np.asarray(b.scores), scores_before,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_constant_labels_constant_prediction():
+    r = np.random.RandomState(0)
+    X = r.randn(300, 5)
+    y = np.full(300, 3.25)
+    b, _ = _train(X, y, {"objective": "regression", "verbosity": -1}, rounds=5)
+    pred = b.predict(X)
+    np.testing.assert_allclose(pred, 3.25, rtol=1e-3)
+
+
+def test_dart_goss_rf_train():
+    X, y = make_binary()
+    for boost, extra in [("dart", {}), ("goss", {}),
+                         ("rf", {"bagging_freq": 1, "bagging_fraction": 0.7})]:
+        p = {"objective": "binary", "boosting": boost, "metric": "auc",
+             "learning_rate": 0.3, "verbosity": -1}
+        p.update(extra)
+        b, _ = _train(X, y, p, rounds=15)
+        res = dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+        assert res["auc"] > 0.85, (boost, res)
+
+
+def test_rf_valid_scores_track_averaged_prediction():
+    """Regression: RF valid cache must equal the averaged model prediction
+    (the raw sums live outside the cache between iterations)."""
+    from lightgbm_tpu.metrics import create_metric
+    X, y = make_binary(n=1000)
+    Xv, yv = make_binary(n=300, seed=9)
+    cfg = Config({"objective": "binary", "boosting": "rf", "bagging_freq": 1,
+                  "bagging_fraction": 0.7, "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    dv = BinnedDataset.from_matrix(Xv, cfg, label=yv, reference=ds)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    b.add_valid_data(dv, [create_metric("binary_logloss", cfg)])
+    for _ in range(4):
+        b.train_one_iter()
+    cache = np.asarray(b._valid_pred_cache[0]["scores"])[:, 0]
+    pred = b.predict(Xv, raw_score=True)
+    np.testing.assert_allclose(cache, pred, rtol=1e-4, atol=1e-5)
+    train_cache = np.asarray(b.scores)[:, 0]
+    np.testing.assert_allclose(train_cache, b.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_model_text_roundtrip_exact_predictions():
+    from lightgbm_tpu.io.model_text import model_to_string, parse_model_string
+    from lightgbm_tpu.core import tree as tm
+    import jax
+    import jax.numpy as jnp
+    X, y = make_binary(n=800)
+    b, ds = _train(X, y, {"objective": "binary", "verbosity": -1}, rounds=8)
+    s = model_to_string(b, ds.feature_names, ds.get_feature_infos())
+    parsed = parse_model_string(s)
+    assert len(parsed["trees"]) == 8
+    assert parsed["objective"].startswith("binary")
+    mx = max(t.num_nodes for t in parsed["trees"])
+    ml = max(t.num_leaves for t in parsed["trees"])
+    stacked = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack(xs)),
+        *[t.predict_table(mx, ml) for t in parsed["trees"]])
+    pl = np.asarray(tm.predict_forest_raw(stacked,
+                                          jnp.asarray(X[:200], jnp.float32)))
+    np.testing.assert_allclose(pl, b.predict(X[:200], raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_feature_importance_counts_splits():
+    X, y = make_binary()
+    b, _ = _train(X, y, {"objective": "binary", "verbosity": -1}, rounds=10)
+    imp = b.feature_importance("split")
+    total_splits = sum(int((t.split_leaf >= 0).sum()) for t in b.models)
+    assert imp.sum() == total_splits
+    gain_imp = b.feature_importance("gain")
+    assert gain_imp.sum() > 0
