@@ -33,7 +33,7 @@ from ..log import Log, LightGBMError, check
 from ..io.dataset import BinnedDataset
 from ..io.binning import BinType, MissingType as BinMissingType
 from ..core.split import FeatureMeta, SplitParams
-from ..core.grow import GrowParams, TreeArrays, grow_tree
+from ..core.grow import GrowParams, TreeArrays, empty_tree, grow_tree
 from ..core import tree as tree_mod
 from ..objectives import ObjectiveFunction
 from ..metrics import Metric
@@ -83,6 +83,25 @@ class HostTree:
         return tree_mod.pack_predict_table(self, max_nodes, max_leaves)
 
 
+def _pad_feature_meta(meta: FeatureMeta, fpad: int) -> FeatureMeta:
+    """Append `fpad` unusable (num_bin=1) features for even column sharding."""
+    if fpad <= 0:
+        return meta
+    return FeatureMeta(
+        num_bin=jnp.concatenate([meta.num_bin,
+                                 jnp.ones((fpad,), jnp.int32)]),
+        missing_type=jnp.concatenate([meta.missing_type,
+                                      jnp.zeros((fpad,), jnp.int32)]),
+        default_bin=jnp.concatenate([meta.default_bin,
+                                     jnp.zeros((fpad,), jnp.int32)]),
+        is_categorical=jnp.concatenate([meta.is_categorical,
+                                        jnp.zeros((fpad,), bool)]),
+        penalty=jnp.concatenate([meta.penalty,
+                                 jnp.ones((fpad,), jnp.float32)]),
+        monotone=jnp.concatenate([meta.monotone,
+                                  jnp.zeros((fpad,), jnp.int32)]))
+
+
 def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta:
     f = ds.num_features
     num_bin = np.array([ds.feature_num_bin(j) for j in range(f)], np.int32)
@@ -102,10 +121,21 @@ def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta
             rj = ds.used_features[j]
             if rj < len(fc):
                 penalty[j] = fc[rj]
+    monotone = np.zeros(f, np.int32)
+    if config.monotone_constraints:
+        mc = np.asarray(config.monotone_constraints, np.int32)
+        # reference CHECKs the constraint list covers every feature
+        # (dataset.cpp:295); silently zero-filling would violate the
+        # constraints the user asked for
+        check(len(mc) == ds.num_total_features,
+              "monotone_constraints has %d entries but the dataset has %d "
+              "features" % (len(mc), ds.num_total_features))
+        for j in range(f):
+            monotone[j] = mc[ds.used_features[j]]
     return FeatureMeta(
         num_bin=jnp.asarray(num_bin), missing_type=jnp.asarray(missing),
         default_bin=jnp.asarray(default_bin), is_categorical=jnp.asarray(is_cat),
-        penalty=jnp.asarray(penalty))
+        penalty=jnp.asarray(penalty), monotone=jnp.asarray(monotone))
 
 
 class GBDT:
@@ -164,10 +194,22 @@ class GBDT:
                 row_valid = np.concatenate(
                     [np.ones(ds.num_data, np.float32),
                      np.zeros(pad, np.float32)])
+            # feature-parallel: pad columns to a multiple of the feature axis
+            # so the [N, F] bin matrix shards evenly; padded columns get
+            # num_bin=1 metadata which the split search treats as unusable
+            fsize = (self.mesh.shape[mesh_mod.FEATURE_AXIS]
+                     if mesh_mod.FEATURE_AXIS in self.mesh.axis_names else 1)
+            fpad = (-xb_np.shape[1]) % fsize
+            if fpad:
+                xb_np = np.concatenate(
+                    [xb_np, np.zeros((xb_np.shape[0], fpad), xb_np.dtype)],
+                    axis=1)
         self.num_data = xb_np.shape[0]
+        self._feature_pad = xb_np.shape[1] - ds.num_features
         self._row_valid = (jnp.asarray(row_valid) if row_valid is not None
                            else None)
-        self.feature_meta = _feature_meta_from_dataset(ds, cfg)
+        self.feature_meta = _pad_feature_meta(
+            _feature_meta_from_dataset(ds, cfg), self._feature_pad)
         self.num_bins = max(ds.max_num_bin(), 2)
         self.xb = jnp.asarray(xb_np)
         if self.mesh is not None:
@@ -195,7 +237,9 @@ class GBDT:
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=cfg.min_data_per_group),
             row_chunk=16384,
-            hist_impl=("scatter" if jax.default_backend() == "cpu" else "matmul"))
+            hist_impl=("scatter" if jax.default_backend() == "cpu" else "matmul"),
+            voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
+                          and self.mesh is not None else 0))
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -263,12 +307,13 @@ class GBDT:
     def _sample_feature_mask(self) -> jnp.ndarray:
         """Per-tree column sampling (serial_tree_learner.cpp:271-292)."""
         f = self.train_data.num_features
+        fpad = getattr(self, "_feature_pad", 0)
         frac = self.config.feature_fraction
         if frac >= 1.0 or f == 0:
-            return jnp.ones((f,), bool)
+            return jnp.ones((f + fpad,), bool)
         used = max(1, int(f * frac))
         idx = self._rng.choice(f, used, replace=False)
-        mask = np.zeros(f, bool)
+        mask = np.zeros(f + fpad, bool)
         mask[idx] = True
         return jnp.asarray(mask)
 
@@ -294,6 +339,7 @@ class GBDT:
         meta = self.feature_meta
         params = self.grow_params
         xb = self.xb
+        mesh = self.mesh
         obj = self.objective
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -340,9 +386,31 @@ class GBDT:
                 h = h * mult[:, None]
                 sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
 
-            def grow_one(gk, hk):
-                return grow_tree(xb, gk, hk, sample_mask, meta, feature_mask,
-                                 params)
+            if params.voting_top_k > 0:
+                # voting-parallel: explicit shard_map so the PV-Tree election
+                # collectives (all_gather of proposals, psum of elected
+                # candidates only) are manual, not GSPMD-inferred
+                from jax.sharding import PartitionSpec as P
+                from ..parallel.mesh import DATA_AXIS
+                tree_spec = jax.tree.map(lambda _: P(),
+                                         empty_tree(params.num_leaves))
+                # check_vma=False: the election (all_gather -> identical vote
+                # -> identical top-k) is device-identical by construction, but
+                # the varying-axes type system cannot prove it
+                grow_sharded = jax.shard_map(
+                    lambda xbj, gj, hj, mj, fm: grow_tree(
+                        xbj, gj, hj, mj, meta, fm, params,
+                        axis_name=DATA_AXIS),
+                    mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
+                                         P(DATA_AXIS), P(DATA_AXIS), P()),
+                    out_specs=(tree_spec, P(DATA_AXIS)), check_vma=False)
+
+                def grow_one(gk, hk):
+                    return grow_sharded(xb, gk, hk, sample_mask, feature_mask)
+            else:
+                def grow_one(gk, hk):
+                    return grow_tree(xb, gk, hk, sample_mask, meta,
+                                     feature_mask, params)
 
             trees, leaf_ids = jax.vmap(grow_one, in_axes=(1, 1))(g, h)
             # score update fast path: leaf_id -> leaf_value (shrinkage applied)

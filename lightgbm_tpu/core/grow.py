@@ -35,7 +35,8 @@ from jax import lax
 from .histogram import build_histogram
 from .split import (BestSplit, FeatureMeta, SplitParams, K_MIN_SCORE,
                     MISSING_NAN, MISSING_NONE, MISSING_ZERO,
-                    calculate_leaf_output, find_best_split_numerical)
+                    calculate_leaf_output, find_best_split_numerical,
+                    per_feature_split_numerical)
 
 
 class GrowParams(NamedTuple):
@@ -46,6 +47,10 @@ class GrowParams(NamedTuple):
     split: SplitParams
     row_chunk: int = 16384
     hist_impl: str = "matmul"
+    # PV-Tree voting-parallel (voting_parallel_tree_learner.cpp): each device
+    # votes its local top_k features; only the elected <=2*top_k candidates'
+    # histograms are globally reduced. 0 = disabled (full reduction).
+    voting_top_k: int = 0
 
 
 class TreeArrays(NamedTuple):
@@ -111,6 +116,8 @@ class _GrowState(NamedTuple):
     hist_pool: jnp.ndarray    # [L, F, B, 3] f32 per-leaf histograms
     best: BestSplit           # per-leaf best split, fields [L]
     tree: TreeArrays
+    leaf_min: jnp.ndarray     # [L] f32 monotone lower output bound
+    leaf_max: jnp.ndarray     # [L] f32 monotone upper output bound
 
 
 def _empty_best(num_leaves: int) -> BestSplit:
@@ -166,18 +173,56 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     b = params.num_bins
     sp = params.split
 
+    voting = params.voting_top_k > 0 and axis_name is not None
+
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
 
     def hist_for_mask(mask_f32):
         h = build_histogram(xb, grad, hess, mask_f32, num_bins=b,
                             row_chunk=params.row_chunk, impl=params.hist_impl)
-        return psum(h)
+        # voting mode keeps histograms LOCAL (the pool then supports local
+        # subtraction); only elected candidates are reduced, in voting_best
+        return h if voting else psum(h)
 
-    def best_for(hist, sum_g, sum_h, cnt, depth_ok):
+    def full_best(hist, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
+                  max_c=jnp.inf):
         bs = find_best_split_numerical(hist, meta, sp, sum_g, sum_h, cnt,
-                                       feature_mask)
+                                       feature_mask, min_constraint=min_c,
+                                       max_constraint=max_c)
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
+
+    def voting_best(hist_local, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
+                    max_c=jnp.inf):
+        """PV-Tree candidate election (voting_parallel_tree_learner.cpp:
+        166-360): rank-local top-k proposals from local-histogram gains, a
+        global vote elects <=2*top_k features, and only those features'
+        histograms are summed across the mesh (comm O(2k*B) vs O(F*B))."""
+        k = min(params.voting_top_k, f)
+        k2 = min(2 * params.voting_top_k, f)
+        # local leaf totals from the local histogram itself: every local row
+        # lands in exactly one bin of feature 0
+        lsg = jnp.sum(hist_local[0, :, 0])
+        lsh = jnp.sum(hist_local[0, :, 1])
+        lsc = jnp.sum(hist_local[0, :, 2])
+        pf = per_feature_split_numerical(hist_local, meta, sp, lsg, lsh,
+                                         lsc, feature_mask)
+        top_gain, top_idx = lax.top_k(pf.gain, k)
+        w = jnp.isfinite(top_gain).astype(jnp.int32)   # only real proposals
+        all_idx = lax.all_gather(top_idx, axis_name).reshape(-1)
+        all_w = lax.all_gather(w, axis_name).reshape(-1)
+        votes = jnp.zeros((f,), jnp.int32).at[all_idx].add(all_w)
+        elected = lax.top_k(votes, k2)[1]
+        cand = lax.psum(jnp.take(hist_local, elected, axis=0), axis_name)
+        gh = jnp.zeros_like(hist_local).at[elected].set(cand)
+        cand_mask = jnp.zeros((f,), bool).at[elected].set(True)
+        bs = find_best_split_numerical(gh, meta, sp, sum_g, sum_h, cnt,
+                                       feature_mask & cand_mask,
+                                       min_constraint=min_c,
+                                       max_constraint=max_c)
+        return bs._replace(gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
+
+    best_for = voting_best if voting else full_best
 
     # ---- root ------------------------------------------------------------
     sample_mask = sample_mask.astype(jnp.float32)
@@ -198,11 +243,20 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
 
     hist_pool = jnp.zeros((l, f, b, 3), jnp.float32)
+    if voting:
+        # the pool holds LOCAL histograms in voting mode -> device-varying
+        hist_pool = lax.pcast(hist_pool, (axis_name,), to="varying")
     hist_pool = hist_pool.at[0].set(hist_root)
 
-    state = _GrowState(
-        leaf_id=jnp.zeros((n,), jnp.int32),
-        hist_pool=hist_pool, best=best, tree=tree)
+    leaf_id0 = jnp.zeros((n,), jnp.int32)
+    if axis_name is not None:
+        # under shard_map the carry must be marked device-varying up front:
+        # it starts as a constant but becomes a function of the sharded rows
+        leaf_id0 = lax.pcast(leaf_id0, (axis_name,), to="varying")
+    state = _GrowState(leaf_id=leaf_id0, hist_pool=hist_pool,
+                       best=best, tree=tree,
+                       leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
+                       leaf_max=jnp.full((l,), jnp.inf, jnp.float32))
 
     def step(t: jnp.ndarray, s: _GrowState) -> _GrowState:
         tree = s.tree
@@ -305,25 +359,48 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
 
+        # monotone constraint propagation (serial_tree_learner.cpp:790-847):
+        # children inherit the parent's output bounds; a monotone split
+        # feature additionally pins the shared boundary at the midpoint of
+        # the two child outputs
+        mono = meta.monotone[cur.feature]
+        mid = (cur.left_output + cur.right_output) * 0.5
+        p_min, p_max = s.leaf_min[leaf], s.leaf_max[leaf]
+        l_min = jnp.where(mono < 0, jnp.maximum(p_min, mid), p_min)
+        l_max = jnp.where(mono > 0, jnp.minimum(p_max, mid), p_max)
+        r_min = jnp.where(mono > 0, jnp.maximum(p_min, mid), p_min)
+        r_max = jnp.where(mono < 0, jnp.minimum(p_max, mid), p_max)
+        leaf_min = _masked_set(_masked_set(s.leaf_min, leaf, l_min, valid),
+                               right_leaf, r_min, valid)
+        leaf_max = _masked_set(_masked_set(s.leaf_max, leaf, l_max, valid),
+                               right_leaf, r_max, valid)
+
         def child_bests(_):
             bl = best_for(hist_left, cur.left_sum_grad, cur.left_sum_hess,
-                          cur.left_count, depth_ok)
+                          cur.left_count, depth_ok, l_min, l_max)
             br = best_for(hist_right, cur.right_sum_grad, cur.right_sum_hess,
-                          cur.right_count, depth_ok)
+                          cur.right_count, depth_ok, r_min, r_max)
             return bl, br
 
         def dead_bests(_):
             dead = jax.tree.map(lambda a: a[0], _empty_best(1))
             return dead, dead
 
-        bl, br = lax.cond(valid, child_bests, dead_bests, operand=None)
+        if voting:
+            # voting_best holds collectives (all_gather/psum) — it cannot sit
+            # under a cond branch; dead iterations just elect over zeros and
+            # are discarded by the masked best-update below
+            bl, br = child_bests(None)
+        else:
+            bl, br = lax.cond(valid, child_bests, dead_bests, operand=None)
         best = jax.tree.map(
             lambda arr, vl, vr: _masked_set(_masked_set(arr, leaf, vl, valid),
                                             right_leaf, vr, valid),
             s.best, bl, br)
 
         return _GrowState(leaf_id=leaf_id, hist_pool=hist_pool,
-                          best=best, tree=tree)
+                          best=best, tree=tree,
+                          leaf_min=leaf_min, leaf_max=leaf_max)
 
     state = lax.fori_loop(0, l - 1, step, state)
     return state.tree, state.leaf_id

@@ -44,6 +44,7 @@ class FeatureMeta(NamedTuple):
     default_bin: jnp.ndarray    # [F] int32
     is_categorical: jnp.ndarray  # [F] bool
     penalty: jnp.ndarray        # [F] f32 feature_contri multiplier
+    monotone: jnp.ndarray       # [F] int32 (-1/0/+1, config.h monotone_constraints)
 
 
 class SplitParams(NamedTuple):
@@ -121,7 +122,19 @@ def _split_gains(lg, lh, rg, rh, p: SplitParams, min_c, max_c, monotone):
     return jnp.where(bad, 0.0, gain), lo, ro
 
 
-def find_best_split_numerical(
+class PerFeatureSplit(NamedTuple):
+    """Best numerical split of every feature (pre-argmax), fields [F]."""
+    gain: jnp.ndarray          # shifted, penalty-scaled gain; -inf unusable
+    threshold: jnp.ndarray     # int32
+    default_left: jnp.ndarray  # bool
+    left_sum_grad: jnp.ndarray
+    left_sum_hess: jnp.ndarray
+    left_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def per_feature_split_numerical(
         hist: jnp.ndarray,          # [F, B, 3] (grad, hess, count)
         meta: FeatureMeta,
         params: SplitParams,
@@ -132,7 +145,7 @@ def find_best_split_numerical(
         monotone: Optional[jnp.ndarray] = None,   # [F] int8
         min_constraint: float | jnp.ndarray = -jnp.inf,
         max_constraint: float | jnp.ndarray = jnp.inf,
-) -> BestSplit:
+) -> PerFeatureSplit:
     """Vectorized FindBestThresholdNumerical over all features at once.
 
     Candidate layout: threshold t means left = bins <= t. The missing-left
@@ -140,11 +153,15 @@ def find_best_split_numerical(
     bin; missing-right (dir=+1) accumulates the left side from bin 0. With a
     full dense histogram (no ``bias`` offset — we always store bin 0) both
     reduce to masked prefix sums.
+
+    Also the voting-parallel learner's local scorer: PV-Tree votes on each
+    rank's per-feature best gains (voting_parallel_tree_learner.cpp:322-342),
+    which is exactly this function applied to a local histogram.
     """
     f, b, _ = hist.shape
     sum_hess = sum_hess + 2 * K_EPSILON
     if monotone is None:
-        monotone = jnp.zeros((f,), dtype=jnp.int32)
+        monotone = meta.monotone
 
     bins = jnp.arange(b, dtype=jnp.int32)[None, :]            # [1, B]
     num_bin = meta.num_bin[:, None]                            # [F, 1]
@@ -244,24 +261,49 @@ def find_best_split_numerical(
     # feature penalty multiplies the (shifted) gain (FindBestThreshold :81)
     out_gain = (per_feat_gain - min_gain_shift) * meta.penalty
 
-    best_f = jnp.argmax(out_gain).astype(jnp.int32)
+    return PerFeatureSplit(
+        gain=out_gain,
+        threshold=per_feat_thr,
+        default_left=default_left,
+        left_sum_grad=lg_best,
+        left_sum_hess=lh_best - K_EPSILON,   # strip the numeric-safety pad
+        left_count=lc_best,
+        left_output=lo_best,
+        right_output=ro_best,
+    )
+
+
+def find_best_split_numerical(
+        hist: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
+        sum_grad: jnp.ndarray, sum_hess: jnp.ndarray, num_data: jnp.ndarray,
+        feature_mask: jnp.ndarray,
+        monotone: Optional[jnp.ndarray] = None,
+        min_constraint: float | jnp.ndarray = -jnp.inf,
+        max_constraint: float | jnp.ndarray = jnp.inf,
+) -> BestSplit:
+    """ArgMax over per-feature best splits (SplitInfo selection,
+    serial_tree_learner.cpp:506-591)."""
+    pf = per_feature_split_numerical(
+        hist, meta, params, sum_grad, sum_hess, num_data, feature_mask,
+        monotone, min_constraint, max_constraint)
+    best_f = jnp.argmax(pf.gain).astype(jnp.int32)
     sel = lambda a: a[best_f]
-    gain = out_gain[best_f]
+    gain = pf.gain[best_f]
     splittable = jnp.isfinite(gain)
     zeros8 = jnp.zeros((8,), dtype=jnp.uint32)
     return BestSplit(
         gain=jnp.where(splittable, gain, K_MIN_SCORE),
         feature=best_f,
-        threshold=sel(per_feat_thr),
-        default_left=sel(default_left),
-        left_sum_grad=sel(lg_best),
-        left_sum_hess=sel(lh_best) - K_EPSILON,
-        left_count=sel(lc_best),
-        right_sum_grad=sum_grad - sel(lg_best),
-        right_sum_hess=sum_hess - sel(lh_best) - K_EPSILON,
-        right_count=num_data - sel(lc_best),
-        left_output=sel(lo_best),
-        right_output=sel(ro_best),
+        threshold=sel(pf.threshold),
+        default_left=sel(pf.default_left),
+        left_sum_grad=sel(pf.left_sum_grad),
+        left_sum_hess=sel(pf.left_sum_hess),
+        left_count=sel(pf.left_count),
+        right_sum_grad=sum_grad - sel(pf.left_sum_grad),
+        right_sum_hess=sum_hess - sel(pf.left_sum_hess),
+        right_count=num_data - sel(pf.left_count),
+        left_output=sel(pf.left_output),
+        right_output=sel(pf.right_output),
         is_categorical=jnp.asarray(False),
         cat_bitset=zeros8,
     )

@@ -7,13 +7,20 @@ data/feature/voting-parallel code paths run in CI without a TPU pod.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA_FLAGS is read when the CPU client is created, which is still ahead of
+# us even if jax was already imported (e.g. by a pytest plugin).
+os.environ["JAX_PLATFORMS"] = "cpu"   # for any subprocesses we spawn
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
+
+# jax may have been imported before this conftest (pytest plugins), in which
+# case it latched JAX_PLATFORMS from the original environment (e.g. a TPU
+# tunnel); config.update still wins as long as no backend exists yet.
+jax.config.update("jax_platforms", "cpu")
 
 # persistent compilation cache: the tree-growth graph is expensive to compile
 # on the CPU backend; cache hits make repeat test runs fast

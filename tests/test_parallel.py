@@ -85,6 +85,35 @@ def test_feature_parallel_matches_serial():
     assert abs(auc_s - auc_f) < 1e-3
 
 
+def test_voting_parallel_close_to_serial():
+    """PV-Tree voting (voting_parallel_tree_learner.cpp) is approximate —
+    the elected candidate set can miss the global best — but with top_k >=
+    num_features it must contain every feature and match data-parallel."""
+    X, y = make_binary(n=1600)
+    serial = _train({"objective": "binary", "metric": "auc",
+                     "verbosity": -1}, X, y, rounds=5)
+    vp = _train({"objective": "binary", "metric": "auc",
+                 "tree_learner": "voting", "top_k": 20,  # > 10 features
+                 "verbosity": -1}, X, y, rounds=5)
+    auc_s = dict((m, v) for _, m, v, _ in serial.get_eval_at(0))["auc"]
+    auc_v = dict((m, v) for _, m, v, _ in vp.get_eval_at(0))["auc"]
+    assert abs(auc_s - auc_v) < 1e-3
+    ps = serial.predict(X[:200], raw_score=True)
+    pv = vp.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(ps, pv, rtol=1e-3, atol=1e-3)
+
+
+def test_voting_parallel_small_top_k():
+    """With a tight top_k the vote compresses comm; accuracy should still be
+    in the same ballpark (PV-Tree's claim)."""
+    X, y = make_binary(n=1600)
+    vp = _train({"objective": "binary", "metric": "auc",
+                 "tree_learner": "voting", "top_k": 3,
+                 "verbosity": -1}, X, y, rounds=8)
+    auc = dict((m, v) for _, m, v, _ in vp.get_eval_at(0))["auc"]
+    assert auc > 0.9
+
+
 def test_data_parallel_through_python_api():
     X, y = make_binary(n=1600)
     bst = lgb.train({"objective": "binary", "tree_learner": "data",
@@ -114,7 +143,8 @@ def test_grow_tree_explicit_psum_path():
         missing_type=jnp.zeros((f,), jnp.int32),
         default_bin=jnp.zeros((f,), jnp.int32),
         is_categorical=jnp.zeros((f,), bool),
-        penalty=jnp.ones((f,), jnp.float32))
+        penalty=jnp.ones((f,), jnp.float32),
+        monotone=jnp.zeros((f,), jnp.int32))
     sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, max_delta_step=0.0,
                      min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
                      min_gain_to_split=0.0, max_cat_threshold=32,

@@ -30,7 +30,8 @@ def _meta(num_bins, missing=None, default_bin=None, is_cat=None):
                                 else [0] * f, jnp.int32),
         is_categorical=jnp.asarray(is_cat if is_cat is not None
                                    else [False] * f, bool),
-        penalty=jnp.ones((f,), jnp.float32))
+        penalty=jnp.ones((f,), jnp.float32),
+        monotone=jnp.zeros((f,), jnp.int32))
 
 
 def _brute_force_best(hist, num_bin, p, sum_g, sum_h, cnt):
