@@ -453,16 +453,85 @@ class Booster:
                 else None
         if pred_contrib:
             return self._impl_predict_contrib(X, num_iteration)
-        return self._impl.predict(X, num_iteration=num_iteration,
-                                  raw_score=raw_score, pred_leaf=pred_leaf)
+        return self._impl.predict(
+            X, num_iteration=num_iteration, raw_score=raw_score,
+            pred_leaf=pred_leaf,
+            pred_early_stop=kwargs.get("pred_early_stop", False),
+            pred_early_stop_freq=kwargs.get("pred_early_stop_freq", 10),
+            pred_early_stop_margin=kwargs.get("pred_early_stop_margin", 10.0))
 
     def _impl_predict_contrib(self, X, num_iteration):
         from .core.shap import predict_contrib
         return predict_contrib(self._impl, X, num_iteration)
 
-    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
-        from .engine import train as _train_fn
-        raise LightGBMError("refit is not implemented yet")
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              group=None, **kwargs) -> "Booster":
+        """Refit existing tree structures to new data (RefitTree,
+        gbdt.cpp:263-286 + FitByExistingTree, serial_tree_learner.cpp:235-265):
+        every split is kept, leaf outputs are re-estimated from the new data's
+        gradients and blended with the old outputs by ``decay_rate``."""
+        import jax
+        import jax.numpy as jnp
+        from .core import tree as tree_mod
+        from .io.dataset import Metadata
+
+        check(self._impl is not None and self._impl.models,
+              "Cannot refit: no trained model")
+        check(self._objective is not None,
+              "Cannot refit a model trained with a custom objective")
+        X = _to_2d_float(data)
+        n = X.shape[0]
+        k = self._impl.num_tree_per_iteration
+        models = self._impl.models
+
+        md = Metadata(n)
+        md.set_label(_to_1d(label))
+        if weight is not None:
+            md.set_weight(_to_1d(weight))
+        if group is not None:
+            md.set_query(np.asarray(group, np.int64))
+        obj = copy.deepcopy(self._objective)
+        obj.init(md, n)
+        cfg = self.config
+        l1, l2, mds = cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
+
+        xj = jnp.asarray(X, jnp.float32)
+        scores = np.zeros((n, k), np.float32)
+        g = h = None
+        new_trees = []
+        for i, ht in enumerate(models):
+            c = i % k
+            if c == 0:  # gradients refresh once per boosting iteration
+                if k == 1:
+                    gj, hj = obj.get_gradients(jnp.asarray(scores[:, 0]))
+                    g, h = np.asarray(gj)[:, None], np.asarray(hj)[:, None]
+                else:
+                    gj, hj = obj.get_gradients(jnp.asarray(scores))
+                    g, h = np.asarray(gj), np.asarray(hj)
+            nl = ht.num_leaves_actual
+            pt = jax.tree.map(jnp.asarray,
+                              ht.predict_table(max(nl - 1, 1), max(nl, 1)))
+            leaves = np.asarray(tree_mod.predict_tree_leaves_raw(pt, xj))
+            sg = np.bincount(leaves, weights=g[:, c].astype(np.float64),
+                             minlength=nl)
+            sh = np.bincount(leaves, weights=h[:, c].astype(np.float64),
+                             minlength=nl)
+            # CalculateSplittedLeafOutput (feature_histogram.hpp:454-462)
+            out = -np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0) \
+                / (sh + l2 + 1e-15)
+            if mds > 0:
+                out = np.clip(out, -mds, mds)
+            out *= getattr(ht, "shrinkage", 1.0)
+            nh = copy.deepcopy(ht)
+            old = ht.leaf_value[:nl].astype(np.float64)
+            nh.leaf_value = ht.leaf_value.copy()
+            nh.leaf_value[:nl] = decay_rate * old + (1.0 - decay_rate) * out
+            scores[:, c] += nh.leaf_value[leaves].astype(np.float32)
+            new_trees.append(nh)
+
+        refitted = Booster(model_str=self.model_to_string())
+        refitted._impl.models = new_trees
+        return refitted
 
     # ------------------------------------------------------------ model IO
     def _feature_names(self) -> List[str]:
@@ -510,6 +579,32 @@ class Booster:
 
     def feature_name(self) -> List[str]:
         return self._feature_names()
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of threshold values this feature was split on
+        (basic.py get_split_value_histogram; reference test
+        test_engine.py:1247)."""
+        if isinstance(feature, str):
+            names = self._feature_names()
+            check(feature in names, "Feature %s not found" % feature)
+            feature = names.index(feature)
+        values = []
+        for ht in self._impl.models:
+            nn = ht.num_leaves_actual - 1
+            for t in range(max(nn, 0)):
+                if (ht.split_feature[t] == feature
+                        and not ht.is_categorical[t]):
+                    values.append(float(ht.threshold[t]))
+        values = np.asarray(values, np.float64)
+        if bins is None:
+            bins = max(min(len(values), 255), 1)
+        hist, edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            rows = [(edges[i + 1], int(hist[i])) for i in range(len(hist))
+                    if hist[i] > 0]
+            return np.asarray(rows, np.float64).reshape(-1, 2)
+        return hist, edges
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """basic.py reset_parameter → learning-rate etc. mid-training."""

@@ -666,9 +666,13 @@ class GBDT:
         return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *tables)
 
     def predict(self, data: np.ndarray, num_iteration: Optional[int] = None,
-                raw_score: bool = False, pred_leaf: bool = False) -> np.ndarray:
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Batch prediction on raw feature values (GBDT::Predict,
-        gbdt_prediction.cpp:49-83)."""
+        gbdt_prediction.cpp:49-83; early stop:
+        src/boosting/prediction_early_stop.cpp)."""
         data = np.asarray(data, np.float32)
         if data.ndim == 1:
             data = data.reshape(1, -1)
@@ -677,8 +681,32 @@ class GBDT:
         use_iters = total_iters if num_iteration is None or num_iteration <= 0 \
             else min(num_iteration, total_iters)
         n = data.shape[0]
+        if pred_early_stop and self.objective is not None \
+                and self.objective.need_accurate_prediction:
+            # reference only early-stops classification margins
+            # (predictor.hpp:39, NeedAccuratePrediction)
+            pred_early_stop = False
         if use_iters == 0:
             out = np.zeros((n, k), np.float64)
+        elif pred_early_stop and not pred_leaf:
+            x = jnp.asarray(data)
+            max_nodes = max(t.num_nodes for t in self.models) or 1
+            max_leaves = max(t.num_leaves for t in self.models)
+            tables = [[self.models[it * k + c].predict_table(max_nodes,
+                                                             max_leaves)
+                       for c in range(k)] for it in range(use_iters)]
+            stacked = jax.tree.map(
+                lambda *xs: jnp.asarray(np.stack(xs).reshape(
+                    (use_iters, k) + np.asarray(xs[0]).shape)),
+                *[t for row in tables for t in row])
+            out = np.asarray(tree_mod.predict_forest_early_stop(
+                stacked, x, max(pred_early_stop_freq, 1),
+                pred_early_stop_margin, is_multiclass=(k > 1)), np.float64)
+            if self.average_output:
+                out = out / use_iters
+            if not raw_score and self.objective is not None:
+                out = np.asarray(self.objective.convert_output(jnp.asarray(out)))
+            return out[:, 0] if k == 1 else out
         else:
             x = jnp.asarray(data)
             outs = []

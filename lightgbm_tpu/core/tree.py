@@ -128,3 +128,39 @@ def predict_forest_leaves_raw(trees: PredictTree, x: jnp.ndarray) -> jnp.ndarray
 
     _, leaves = lax.scan(body, 0, trees)
     return leaves.T
+
+
+def predict_forest_early_stop(trees: PredictTree, x: jnp.ndarray,
+                              freq: int, margin: float,
+                              is_multiclass: bool) -> jnp.ndarray:
+    """Forest prediction with margin-based per-row early stop
+    (src/boosting/prediction_early_stop.cpp): every ``freq`` iterations rows
+    whose margin (binary: 2*|score|; multiclass: top1-top2) exceeds
+    ``margin`` stop accumulating further trees.
+
+    ``trees`` fields are stacked [iters, K, ...]; returns [N, K] raw scores.
+    The reference stops the per-row tree loop on CPU; here the whole batch
+    keeps running but stopped rows freeze — same results, SPMD-friendly.
+    """
+    n = x.shape[0]
+    k = trees.leaf_value.shape[1]
+
+    def margin_of(acc):  # acc [N, K]
+        if is_multiclass and k > 1:
+            top2 = lax.top_k(acc, 2)[0]
+            return top2[:, 0] - top2[:, 1]
+        return 2.0 * jnp.abs(acc[:, 0])
+
+    def body(carry, tree_k):
+        acc, stopped, it = carry
+        delta = jax.vmap(lambda t: predict_tree_raw(t, x))(tree_k)  # [K, N]
+        acc = acc + jnp.where(stopped[:, None], 0.0, delta.T)
+        it = it + 1
+        check_now = (it % freq) == 0
+        stopped = stopped | (check_now & (margin_of(acc) >= margin))
+        return (acc, stopped, it), None
+
+    init = (jnp.zeros((n, k), jnp.float32), jnp.zeros((n,), bool),
+            jnp.asarray(0, jnp.int32))
+    (acc, _, _), _ = lax.scan(body, init, trees)
+    return acc

@@ -368,3 +368,115 @@ def model_to_json(booster, feature_names: List[str],
         "feature_infos": feature_infos,
         "tree_info": trees,
     }, indent=2)
+
+
+def model_to_cpp(parsed: Dict) -> str:
+    """Standalone C++ scorer from a parsed model (the ModelToIfElse /
+    convert_model export, gbdt_model_text.cpp:60-243): one nested if/else
+    function per tree plus PredictRaw / Predict entry points with the
+    objective's link function applied."""
+    trees: List = parsed["trees"]
+    k = parsed["num_tree_per_iteration"]
+    obj = parsed.get("objective", "").split()
+    obj_name = obj[0] if obj else ""
+    sigmoid = 1.0
+    for tok in obj[1:]:
+        if tok.startswith("sigmoid:"):
+            sigmoid = float(tok.split(":", 1)[1])
+
+    lines: List[str] = [
+        "// generated by lightgbm_tpu task=convert_model",
+        "#include <cmath>",
+        "#include <cstring>",
+        "",
+        "static inline bool IsZero(double v) "
+        "{ return v > -1e-35 && v <= 1e-35; }",
+        "",
+    ]
+
+    def emit_node(ht, root, root_depth):
+        # explicit work stack — trees can be chain-shaped (depth ~num_leaves)
+        # and must not hit the Python recursion limit
+        stack = [("node", root, root_depth)]
+        while stack:
+            kind, payload, depth = stack.pop()
+            pad = "  " * depth
+            if kind == "text":
+                lines.append(pad + payload)
+                continue
+            index = payload
+            if index < 0:
+                lines.append("%sreturn %.17g;"
+                             % (pad, float(ht.leaf_value[~index])))
+                continue
+            f = int(ht.split_feature[index])
+            missing = int(ht.missing_type[index])
+            dl = bool(ht.default_left[index])
+            if ht.is_categorical[index]:
+                words = ", ".join("0x%xu" % int(w)
+                                  for w in ht.cat_bitset[index])
+                lines.append(
+                    "%s{ static const unsigned cat[8] = {%s};" % (pad, words))
+                lines.append("%s  int c = (int)arr[%d];" % (pad, f))
+                lines.append(
+                    "%s  if (!std::isnan(arr[%d]) && c >= 0 && c < 256 && "
+                    "((cat[c >> 5] >> (c & 31)) & 1)) {" % (pad, f))
+                closer = "} }"
+            else:
+                thr = float(ht.threshold[index])
+                cond = "arr[%d] <= %.17g" % (f, thr)
+                if missing == 2:    # NaN
+                    cond = ("(std::isnan(arr[%d]) ? %s : (%s))"
+                            % (f, "true" if dl else "false", cond))
+                elif missing == 1:  # Zero
+                    cond = ("((IsZero(arr[%d]) || std::isnan(arr[%d])) ? "
+                            "%s : (%s))"
+                            % (f, f, "true" if dl else "false", cond))
+                else:
+                    cond = ("(std::isnan(arr[%d]) ? 0.0 <= %.17g : (%s))"
+                            % (f, thr, cond))
+                lines.append("%sif (%s) {" % (pad, cond))
+                closer = "}"
+            stack.append(("text", closer, depth))
+            stack.append(("node", int(ht.right_child[index]), depth + 1))
+            stack.append(("text", "} else {", depth))
+            stack.append(("node", int(ht.left_child[index]), depth + 1))
+
+    for i, ht in enumerate(trees):
+        lines.append("static double PredictTree%d(const double* arr) {" % i)
+        if ht.num_leaves_actual <= 1:
+            lines.append("  return %.17g;" % float(ht.leaf_value[0]))
+        else:
+            emit_node(ht, 0, 1)
+        lines.append("}")
+        lines.append("")
+
+    lines.append('extern "C" void PredictRaw(const double* arr, double* out) {')
+    lines.append("  for (int c = 0; c < %d; ++c) out[c] = 0.0;" % k)
+    for i in range(len(trees)):
+        lines.append("  out[%d] += PredictTree%d(arr);" % (i % k, i))
+    if parsed.get("average_output"):
+        niter = max(len(trees) // max(k, 1), 1)
+        lines.append("  for (int c = 0; c < %d; ++c) out[c] /= %d.0;"
+                     % (k, niter))
+    lines.append("}")
+    lines.append("")
+    lines.append('extern "C" void Predict(const double* arr, double* out) {')
+    lines.append("  PredictRaw(arr, out);")
+    if obj_name in ("binary", "cross_entropy", "xentropy"):
+        lines.append("  out[0] = 1.0 / (1.0 + std::exp(%.17g * -out[0]));"
+                     % sigmoid)
+    elif obj_name in ("multiclass", "softmax"):
+        lines.append("  double m = out[0], s = 0.0;")
+        lines.append("  for (int c = 1; c < %d; ++c) if (out[c] > m) m = out[c];" % k)
+        lines.append("  for (int c = 0; c < %d; ++c) { out[c] = std::exp(out[c] - m); s += out[c]; }" % k)
+        lines.append("  for (int c = 0; c < %d; ++c) out[c] /= s;" % k)
+    elif obj_name in ("multiclassova", "multiclass_ova", "ova", "ovr"):
+        lines.append("  for (int c = 0; c < %d; ++c) "
+                     "out[c] = 1.0 / (1.0 + std::exp(%.17g * -out[c]));"
+                     % (k, sigmoid))
+    elif obj_name in ("poisson", "gamma", "tweedie"):
+        lines.append("  for (int c = 0; c < %d; ++c) out[c] = std::exp(out[c]);" % k)
+    lines.append("}")
+    lines.append("")
+    return "\n".join(lines)

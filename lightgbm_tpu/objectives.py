@@ -33,6 +33,9 @@ class ObjectiveFunction:
     num_model_per_iteration = 1
     is_constant_hessian = False
     need_query = False
+    # objective_function.h NeedAccuratePrediction: only classification
+    # margins tolerate prediction early stop (predictor.hpp:39)
+    need_accurate_prediction = True
 
     def __init__(self, config: Config):
         self.config = config
@@ -266,6 +269,7 @@ class RegressionTweedieLoss(RegressionPoissonLoss):
 # -------------------------------------------------------------------- binary
 class BinaryLogloss(ObjectiveFunction):
     """binary_objective.hpp:20-190."""
+    need_accurate_prediction = False
     name = "binary"
 
     def init(self, metadata, num_data):
@@ -318,6 +322,7 @@ class BinaryLogloss(ObjectiveFunction):
 # ---------------------------------------------------------------- multiclass
 class MulticlassSoftmax(ObjectiveFunction):
     """multiclass_objective.hpp:20-160: K trees/iteration, softmax."""
+    need_accurate_prediction = False
     name = "multiclass"
 
     def __init__(self, config):
@@ -354,6 +359,7 @@ class MulticlassSoftmax(ObjectiveFunction):
 
 class MulticlassOVA(ObjectiveFunction):
     """multiclass_objective.hpp:170-259: K independent binary objectives."""
+    need_accurate_prediction = False
     name = "multiclassova"
 
     def __init__(self, config):

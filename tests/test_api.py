@@ -190,3 +190,35 @@ def test_learning_rates_schedule():
                     lgb.Dataset(X, label=y), num_boost_round=6,
                     learning_rates=lambda i: 0.3 * (0.5 ** i))
     assert bst.current_iteration == 6
+
+
+def test_prediction_early_stop():
+    """Margin-based prediction early stop (prediction_early_stop.cpp):
+    approximate, but high-margin rows must agree with full predict."""
+    from conftest import make_binary
+    X, y = make_binary(n=1200)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    full = bst.predict(X[:300])
+    es = bst.predict(X[:300], pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=10.0)
+    assert es.shape == full.shape
+    # huge margin never triggers -> exact match
+    es_never = bst.predict(X[:300], pred_early_stop=True,
+                           pred_early_stop_freq=5,
+                           pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(es_never, full, rtol=1e-6, atol=1e-7)
+    # decisions agree on confidently-classified rows
+    confident = np.abs(full - 0.5) > 0.45
+    assert ((es > 0.5) == (full > 0.5))[confident].all()
+
+
+def test_get_split_value_histogram():
+    from conftest import make_regression
+    X, y = make_regression(n=1500)
+    bst = lgb.train({"objective": "regression", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    hist, edges = bst.get_split_value_histogram(0)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    rows = bst.get_split_value_histogram(0, xgboost_style=True)
+    assert rows.ndim == 2 and rows.shape[1] == 2

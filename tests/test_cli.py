@@ -1,0 +1,145 @@
+"""CLI application tests, mirroring the reference's cpp_test determinism
+style (tests/cpp_test/test.py: train via conf, predict, compare) plus
+convert_model / refit coverage."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import kv2map, load_parameters, main
+
+from conftest import make_binary
+
+
+def _write_data(path, X, y):
+    with open(path, "w") as fh:
+        for xi, yi in zip(X, y):
+            fh.write("%g," % yi + ",".join("%g" % v for v in xi) + "\n")
+
+
+@pytest.fixture(scope="module")
+def data_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    X, y = make_binary(n=800, f=6)
+    _write_data(str(d / "train.csv"), X[:600], y[:600])
+    _write_data(str(d / "valid.csv"), X[600:], y[600:])
+    return d, X, y
+
+
+def test_kv2map_and_config_file(tmp_path):
+    assert kv2map(["a=1", "b = x", "# comment", "c=2 # tail"]) == \
+        {"a": "1", "b": "x", "c": "2"}
+    conf = tmp_path / "t.conf"
+    conf.write_text("task = train\nnum_trees = 7\n# comment\ndata=d.csv\n")
+    params = load_parameters(["config=%s" % conf, "num_trees=9"])
+    assert params["num_trees"] == "9"       # command line wins
+    assert params["task"] == "train"
+    assert params["data"] == "d.csv"
+
+
+def test_cli_train_predict_roundtrip(data_files, tmp_path):
+    d, X, y = data_files
+    model = str(tmp_path / "model.txt")
+    result = str(tmp_path / "preds.txt")
+    rc = main(["task=train", "data=%s" % (d / "train.csv"),
+               "valid=%s" % (d / "valid.csv"),
+               "objective=binary", "metric=auc", "num_trees=10",
+               "num_leaves=15", "verbosity=-1",
+               "output_model=%s" % model])
+    assert rc == 0 and os.path.exists(model)
+    rc = main(["task=predict", "data=%s" % (d / "valid.csv"),
+               "input_model=%s" % model, "verbosity=-1",
+               "output_result=%s" % result])
+    assert rc == 0
+    preds = np.loadtxt(result)
+    assert preds.shape == (200,)
+    # CLI predictions equal Python-API predictions from the saved model
+    # (cross-interface consistency, tests/test_consistency.py style)
+    bst = lgb.Booster(model_file=model)
+    np.testing.assert_allclose(preds, bst.predict(X[600:]), rtol=1e-6,
+                               atol=1e-10)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y[600:], preds) > 0.85
+
+
+def test_cli_determinism(data_files, tmp_path):
+    """Training twice with the same conf yields identical predictions
+    (tests/cpp_test/test.py behavior)."""
+    d, X, y = data_files
+    outs = []
+    for tag in ("a", "b"):
+        model = str(tmp_path / ("m_%s.txt" % tag))
+        main(["task=train", "data=%s" % (d / "train.csv"),
+              "objective=binary", "num_trees=5", "verbosity=-1",
+              "output_model=%s" % model])
+        outs.append(lgb.Booster(model_file=model).predict(X[:100]))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_cli_convert_model(data_files, tmp_path):
+    d, X, y = data_files
+    model = str(tmp_path / "model.txt")
+    cpp = str(tmp_path / "scorer.cpp")
+    main(["task=train", "data=%s" % (d / "train.csv"),
+          "objective=binary", "num_trees=5", "num_leaves=7", "verbosity=-1",
+          "output_model=%s" % model])
+    rc = main(["task=convert_model", "input_model=%s" % model,
+               "convert_model=%s" % cpp, "verbosity=-1"])
+    assert rc == 0
+    src = open(cpp).read()
+    assert "PredictTree0" in src and '"C" void Predict' in src
+
+    # compile the generated scorer and compare outputs with Python predict
+    lib = str(tmp_path / "scorer.so")
+    r = subprocess.run(["g++", "-O2", "-shared", "-fPIC", cpp, "-o", lib],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    import ctypes
+    so = ctypes.CDLL(lib)
+    so.Predict.argtypes = [ctypes.POINTER(ctypes.c_double),
+                           ctypes.POINTER(ctypes.c_double)]
+    bst = lgb.Booster(model_file=model)
+    ref = bst.predict(X[:50])
+    out = ctypes.c_double()
+    got = []
+    for row in X[:50]:
+        arr = (ctypes.c_double * len(row))(*row)
+        so.Predict(arr, ctypes.byref(out))
+        got.append(out.value)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-12)
+
+
+def test_refit(data_files, tmp_path):
+    """Booster.refit keeps structure, re-estimates leaves (gbdt.cpp:263-286);
+    reference test: test_engine.py:720."""
+    d, X, y = data_files
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, lgb.Dataset(X[:600], label=y[:600]),
+                    num_boost_round=10)
+    err_before = float(np.mean((bst.predict(X[600:]) > 0.5) != y[600:]))
+    new = bst.refit(X[600:], y[600:], decay_rate=0.0)
+    err_after = float(np.mean((new.predict(X[600:]) > 0.5) != y[600:]))
+    assert err_after <= err_before + 1e-9
+    # structure unchanged
+    assert new.num_trees() == bst.num_trees()
+    for a, b in zip(new._impl.models, bst._impl.models):
+        np.testing.assert_array_equal(a.split_feature, b.split_feature)
+        assert not np.array_equal(a.leaf_value, b.leaf_value)
+
+
+def test_cli_refit_task(data_files, tmp_path):
+    d, X, y = data_files
+    model = str(tmp_path / "model.txt")
+    model2 = str(tmp_path / "model_refit.txt")
+    main(["task=train", "data=%s" % (d / "train.csv"),
+          "objective=binary", "num_trees=5", "verbosity=-1",
+          "output_model=%s" % model])
+    rc = main(["task=refit", "data=%s" % (d / "valid.csv"),
+               "input_model=%s" % model, "output_model=%s" % model2,
+               "verbosity=-1"])
+    assert rc == 0 and os.path.exists(model2)
+    p = lgb.Booster(model_file=model2).predict(X[:50])
+    assert p.shape == (50,)
