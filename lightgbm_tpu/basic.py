@@ -510,7 +510,8 @@ class Booster:
                     g, h = np.asarray(gj), np.asarray(hj)
             nl = ht.num_leaves_actual
             pt = jax.tree.map(jnp.asarray,
-                              ht.predict_table(max(nl - 1, 1), max(nl, 1)))
+                              ht.predict_table(max(len(ht.split_leaf), 1),
+                                               max(len(ht.leaf_value), 1)))
             leaves = np.asarray(tree_mod.predict_tree_leaves_raw(pt, xj))
             sg = np.bincount(leaves, weights=g[:, c].astype(np.float64),
                              minlength=nl)

@@ -56,7 +56,8 @@ class HostTree:
         self.default_left = np.zeros(n, bool)
         self.missing_type = np.zeros(n, np.int32)
         self.is_categorical = np.zeros(n, bool)
-        self.cat_bitset = np.zeros((n, 8), np.uint32)
+        self.cat_bitset = np.zeros((n, 8), np.uint32)      # raw category values
+        self.cat_bitset_bin = np.zeros((n, 8), np.uint32)  # bin indices (train replay)
         self.left_child = np.full(n, -1, np.int32)
         self.right_child = np.full(n, -1, np.int32)
         self.split_leaf = np.full(n, -1, np.int32)
@@ -78,9 +79,11 @@ class HostTree:
         self.internal_value *= rate
         self.shrinkage *= rate
 
-    def predict_table(self, max_nodes: int, max_leaves: int) -> tree_mod.PredictTree:
+    def predict_table(self, max_nodes: int, max_leaves: int,
+                      cat_words: Optional[int] = None) -> tree_mod.PredictTree:
         """Pad to model-wide fixed shapes for stacked device prediction."""
-        return tree_mod.pack_predict_table(self, max_nodes, max_leaves)
+        return tree_mod.pack_predict_table(self, max_nodes, max_leaves,
+                                           cat_words)
 
 
 def _pad_feature_meta(meta: FeatureMeta, fpad: int) -> FeatureMeta:
@@ -239,7 +242,9 @@ class GBDT:
             row_chunk=16384,
             hist_impl=("scatter" if jax.default_backend() == "cpu" else "matmul"),
             voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
-                          and self.mesh is not None else 0))
+                          and self.mesh is not None else 0),
+            with_categorical=bool(np.asarray(self.feature_meta.is_categorical)
+                                  .any()))
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -556,17 +561,33 @@ class GBDT:
             [ds.real_feature_index(int(j)) for j in inner_feat], np.int32)
         ht.split_gain[:nn] = t.split_gain[:nn]
         ht.threshold_bin[:nn] = t.threshold_bin[:nn]
+        # raw-value bitsets are variable-width (Tree cat_threshold_,
+        # tree.h:276-291): wide enough for the largest category value of any
+        # categorical feature in this dataset
+        max_cat_val = max(
+            (max(m.bin_2_categorical) for m in ds.bin_mappers
+             if m.bin_type == BinType.CATEGORICAL and m.bin_2_categorical),
+            default=0)
+        cat_words = max(8, (max_cat_val + 32) // 32)
+        ht.cat_bitset = np.zeros((max(nn, 1), cat_words), np.uint32)
         for i in range(nn):
             mapper = ds.bin_mappers[int(ht.split_feature[i])]
             if bool(t.is_categorical[i]):
                 ht.threshold[i] = 0.0
+                # translate the bin-space bitset into raw category values for
+                # raw-input prediction and model serialization (the reference
+                # stores cat_threshold in value space, tree.cpp)
+                for b in range(1, mapper.num_bin):
+                    if (int(t.cat_bitset[i][b >> 5]) >> (b & 31)) & 1:
+                        v = mapper.bin_2_categorical[b - 1]
+                        ht.cat_bitset[i][v >> 5] |= np.uint32(1 << (v & 31))
             else:
                 tb = int(t.threshold_bin[i])
                 ht.threshold[i] = mapper.bin_to_value(tb)
         ht.default_left[:nn] = t.default_left[:nn]
         ht.missing_type[:nn] = t.missing_type[:nn]
         ht.is_categorical[:nn] = t.is_categorical[:nn]
-        ht.cat_bitset[:nn] = t.cat_bitset[:nn]
+        ht.cat_bitset_bin[:nn] = t.cat_bitset[:nn]
         ht.left_child[:nn] = t.left_child[:nn]
         ht.right_child[:nn] = t.right_child[:nn]
         ht.split_leaf[:nn] = t.split_leaf[:nn]
@@ -628,7 +649,7 @@ class GBDT:
             jnp.asarray(ht.split_leaf), jnp.asarray(inner),
             jnp.asarray(ht.threshold_bin), jnp.asarray(ht.default_left),
             jnp.asarray(ht.missing_type), jnp.asarray(ht.is_categorical),
-            jnp.asarray(ht.cat_bitset), jnp.asarray(num_bin),
+            jnp.asarray(ht.cat_bitset_bin), jnp.asarray(num_bin),
             jnp.asarray(default_bin), xb)
 
     # ------------------------------------------------------------ evaluation
@@ -662,7 +683,9 @@ class GBDT:
         trees = self.models[start:end]
         max_nodes = max((t.num_nodes for t in trees), default=1)
         max_leaves = max((t.num_leaves for t in trees), default=1)
-        tables = [t.predict_table(max_nodes, max_leaves) for t in trees]
+        cat_words = max((t.cat_bitset.shape[1] for t in trees), default=8)
+        tables = [t.predict_table(max_nodes, max_leaves, cat_words)
+                  for t in trees]
         return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *tables)
 
     def predict(self, data: np.ndarray, num_iteration: Optional[int] = None,
@@ -690,15 +713,9 @@ class GBDT:
             out = np.zeros((n, k), np.float64)
         elif pred_early_stop and not pred_leaf:
             x = jnp.asarray(data)
-            max_nodes = max(t.num_nodes for t in self.models) or 1
-            max_leaves = max(t.num_leaves for t in self.models)
-            tables = [[self.models[it * k + c].predict_table(max_nodes,
-                                                             max_leaves)
-                       for c in range(k)] for it in range(use_iters)]
+            flat = self._stacked_predict_trees(0, use_iters * k)
             stacked = jax.tree.map(
-                lambda *xs: jnp.asarray(np.stack(xs).reshape(
-                    (use_iters, k) + np.asarray(xs[0]).shape)),
-                *[t for row in tables for t in row])
+                lambda a: a.reshape((use_iters, k) + a.shape[1:]), flat)
             out = np.asarray(tree_mod.predict_forest_early_stop(
                 stacked, x, max(pred_early_stop_freq, 1),
                 pred_early_stop_margin, is_multiclass=(k > 1)), np.float64)

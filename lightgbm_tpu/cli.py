@@ -27,11 +27,15 @@ from .config import Config
 from .log import Log, LightGBMError
 
 
-def kv2map(tokens: List[str]) -> Dict[str, str]:
-    """Parse key=value tokens (Config::KV2Map, config.cpp:15)."""
+def kv2map(tokens: List[str], strip_comments: bool = False) -> Dict[str, str]:
+    """Parse key=value tokens (Config::KV2Map, config.cpp:15). ``#`` comments
+    are stripped only from config-file lines — command-line values may
+    legitimately contain ``#`` (paths etc.)."""
     out: Dict[str, str] = {}
     for tok in tokens:
-        tok = tok.split("#", 1)[0].strip()
+        if strip_comments:
+            tok = tok.split("#", 1)[0]
+        tok = tok.strip()
         if not tok:
             continue
         if "=" not in tok:
@@ -54,7 +58,7 @@ def load_parameters(argv: List[str]) -> Dict[str, str]:
     params = dict(cmdline)
     if conf_path:
         with open(conf_path, "r") as fh:
-            file_params = kv2map(fh.read().splitlines())
+            file_params = kv2map(fh.read().splitlines(), strip_comments=True)
         for k, v in file_params.items():
             params.setdefault(k, v)
     return params
@@ -209,7 +213,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             raise LightGBMError("Unknown task %r" % config.task)
         task_fn(config, params)
         return 0
-    except LightGBMError as e:
+    except (LightGBMError, OSError, ValueError) as e:
+        # the reference Application catches any std::exception and prints a
+        # one-line error (main.cpp); mirror that for I/O and parse failures
         Log.warning("Met Exceptions: %s", str(e))
         print("Error: %s" % e, file=sys.stderr)
         return 1

@@ -35,8 +35,8 @@ from jax import lax
 from .histogram import build_histogram
 from .split import (BestSplit, FeatureMeta, SplitParams, K_MIN_SCORE,
                     MISSING_NAN, MISSING_NONE, MISSING_ZERO,
-                    calculate_leaf_output, find_best_split_numerical,
-                    per_feature_split_numerical)
+                    calculate_leaf_output, find_best_split,
+                    per_feature_split_merged)
 
 
 class GrowParams(NamedTuple):
@@ -51,6 +51,9 @@ class GrowParams(NamedTuple):
     # votes its local top_k features; only the elected <=2*top_k candidates'
     # histograms are globally reduced. 0 = disabled (full reduction).
     voting_top_k: int = 0
+    # dataset has categorical features -> run the categorical split finder
+    # alongside the numerical one (FindBestThreshold dispatch)
+    with_categorical: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -187,9 +190,10 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     def full_best(hist, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
                   max_c=jnp.inf):
-        bs = find_best_split_numerical(hist, meta, sp, sum_g, sum_h, cnt,
-                                       feature_mask, min_constraint=min_c,
-                                       max_constraint=max_c)
+        bs = find_best_split(hist, meta, sp, sum_g, sum_h, cnt,
+                             feature_mask, min_constraint=min_c,
+                             max_constraint=max_c,
+                             with_categorical=params.with_categorical)
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
 
     def voting_best(hist_local, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
@@ -205,8 +209,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         lsg = jnp.sum(hist_local[0, :, 0])
         lsh = jnp.sum(hist_local[0, :, 1])
         lsc = jnp.sum(hist_local[0, :, 2])
-        pf = per_feature_split_numerical(hist_local, meta, sp, lsg, lsh,
-                                         lsc, feature_mask)
+        pf, _ = per_feature_split_merged(
+            hist_local, meta, sp, lsg, lsh, lsc, feature_mask,
+            with_categorical=params.with_categorical)
         top_gain, top_idx = lax.top_k(pf.gain, k)
         w = jnp.isfinite(top_gain).astype(jnp.int32)   # only real proposals
         all_idx = lax.all_gather(top_idx, axis_name).reshape(-1)
@@ -216,10 +221,10 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cand = lax.psum(jnp.take(hist_local, elected, axis=0), axis_name)
         gh = jnp.zeros_like(hist_local).at[elected].set(cand)
         cand_mask = jnp.zeros((f,), bool).at[elected].set(True)
-        bs = find_best_split_numerical(gh, meta, sp, sum_g, sum_h, cnt,
-                                       feature_mask & cand_mask,
-                                       min_constraint=min_c,
-                                       max_constraint=max_c)
+        bs = find_best_split(gh, meta, sp, sum_g, sum_h, cnt,
+                             feature_mask & cand_mask,
+                             min_constraint=min_c, max_constraint=max_c,
+                             with_categorical=params.with_categorical)
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
 
     best_for = voting_best if voting else full_best

@@ -72,6 +72,10 @@ def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     Returns: [F, B, 3] f32.
     """
     n, f = xb.shape
+    if impl == "pallas" or impl == "pallas_interpret":
+        from .histogram_pallas import build_histogram_pallas
+        return build_histogram_pallas(xb, grad, hess, mask, num_bins,
+                                      interpret=(impl == "pallas_interpret"))
     vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # [N, 3]
     if impl == "scatter" or n <= row_chunk:
         if impl == "scatter":

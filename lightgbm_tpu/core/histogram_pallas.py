@@ -1,0 +1,108 @@
+"""Pallas TPU histogram kernel — the device analog of the reference's OpenCL
+histogram kernels (ocl/histogram256.cl workgroup local-memory design,
+gpu_tree_learner.cpp:951-1045).
+
+Why a kernel at all: the XLA one-hot-matmul path (histogram.py) materializes a
+[rows, F, B] one-hot tensor per row-chunk in HBM — for HIGGS-scale data that
+is hundreds of MB of pure bandwidth per histogram build. Here the one-hot
+tile is created and consumed inside VMEM, so HBM traffic is just
+xb (N*F bytes) + vals (12N bytes) + the [3, F, B] output.
+
+Design (mirrors the OpenCL kernel's structure, re-mapped to TPU):
+- grid = (feature_tiles, row_tiles); the row dimension is the innermost,
+  sequential reduction — each feature tile's accumulator block stays resident
+  in VMEM across all row tiles (the "workgroup local histogram", without
+  atomics because one grid cell owns its bin slice).
+- xb arrives feature-major [F, N] so rows ride the 128-wide lane dimension;
+  vals arrive [3, N] for the same reason.
+- per step: eq[ft, b, c] = (xb[ft, c] == b) built in VMEM, then contracted
+  with vals on the MXU: [3, C] x [Ft*B, C]^T -> [3, Ft, B].
+- accumulation is f32 (like the GPU learner's single-precision histograms,
+  gpu_tree_learner.h:74-78).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jax.experimental import pallas as pl
+try:  # TPU-specific memory spaces; absent on some builds
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _hist_kernel(xb_ref, vals_ref, out_ref, *, num_bins: int):
+    """One (feature_tile, row_tile) grid cell.
+
+    xb_ref: [Ft, C] int8 binned values; vals_ref: [3, C] f32
+    (grad*mask, hess*mask, mask); out_ref: [3, Ft, B] f32 accumulator.
+    """
+    r = pl.program_id(1)
+
+    xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
+    vals = vals_ref[...]                                     # [3, C]
+    ft, c = xb.shape
+    bins = jax.lax.broadcasted_iota(jnp.int32, (c, num_bins), 1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # one 2-D MXU matmul per feature row keeps every operand in a clean
+    # (sublane, lane) layout — no in-kernel reshape across tiled dims
+    for j in range(ft):
+        eq = (xb[j:j + 1, :].T == bins).astype(jnp.float32)  # [C, B]
+        part = jax.lax.dot_general(
+            vals, eq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)             # [3, B]
+        out_ref[:, j, :] += part
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_tile", "feature_tile",
+                                    "interpret"))
+def build_histogram_pallas(xb: jnp.ndarray, grad: jnp.ndarray,
+                           hess: jnp.ndarray, mask: jnp.ndarray,
+                           num_bins: int, row_tile: int = 512,
+                           feature_tile: int = 8,
+                           interpret: bool = False) -> jnp.ndarray:
+    """[N, F] uint8 bins + per-row values -> [F, B, 3] f32 histograms.
+
+    Same contract as histogram.build_histogram. The feature-major transpose
+    of ``xb`` is loop-invariant across the splits of one tree, so XLA hoists
+    it out of the growth loop.
+    """
+    n, f = xb.shape
+    vals = jnp.stack([grad * mask, hess * mask, mask], axis=0)   # [3, N]
+
+    f_pad = (-f) % feature_tile
+    n_pad = (-n) % row_tile
+    # NB: uint8, not int8 — bins >= 128 must not wrap negative
+    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad))).astype(jnp.uint8)
+    vals = jnp.pad(vals, ((0, 0), (0, n_pad)))   # padded rows carry mask 0
+    fp = f + f_pad
+    num_f_tiles = fp // feature_tile
+    num_r_tiles = (n + n_pad) // row_tile
+
+    kernel = functools.partial(_hist_kernel, num_bins=num_bins)
+    out = pl.pallas_call(
+        kernel,
+        grid=(num_f_tiles, num_r_tiles),
+        in_specs=[
+            pl.BlockSpec((feature_tile, row_tile),
+                         lambda i, r: (i, r)),
+            pl.BlockSpec((3, row_tile), lambda i, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((3, feature_tile, num_bins),
+                               lambda i, r: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, fp, num_bins), jnp.float32),
+        interpret=interpret,
+    )(xb_t, vals)
+    return jnp.moveaxis(out, 0, -1)[:f]          # [F, B, 3]

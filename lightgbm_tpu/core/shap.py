@@ -38,7 +38,7 @@ def _decision_go_left(ht, node: int, x: np.ndarray) -> bool:
     fval = x[ht.split_feature[node]]
     missing_type = int(ht.missing_type[node])
     if ht.is_categorical[node]:
-        if np.isnan(fval) or fval < 0 or fval >= 256:
+        if np.isnan(fval) or fval < 0 or fval >= ht.cat_bitset.shape[1] * 32:
             return False
         ci = int(fval)
         return bool((int(ht.cat_bitset[node][ci >> 5]) >> (ci & 31)) & 1)

@@ -307,3 +307,222 @@ def find_best_split_numerical(
         is_categorical=jnp.asarray(False),
         cat_bitset=zeros8,
     )
+
+
+def _split_gains_l2(lg, lh, rg, rh, p: SplitParams, l2, min_c, max_c):
+    """GetSplitGains with an explicit l2 (categorical adds cat_l2,
+    feature_histogram.hpp:171)."""
+    lo = calculate_leaf_output(lg, lh, p.lambda_l1, l2, p.max_delta_step)
+    ro = calculate_leaf_output(rg, rh, p.lambda_l1, l2, p.max_delta_step)
+    lo = jnp.clip(lo, min_c, max_c)
+    ro = jnp.clip(ro, min_c, max_c)
+    gain = (leaf_split_gain_given_output(lg, lh, p.lambda_l1, l2, lo)
+            + leaf_split_gain_given_output(rg, rh, p.lambda_l1, l2, ro))
+    return gain, lo, ro
+
+
+def _bin_membership_bitset(member: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool -> [8] uint32 bitset over bin indices (SplitInfo
+    cat_threshold as a fixed 256-bit set)."""
+    b = member.shape[0]
+    idx = jnp.arange(b, dtype=jnp.uint32)
+    bits = member.astype(jnp.uint32) << (idx & 31)
+    return jax.ops.segment_sum(bits, (idx >> 5).astype(jnp.int32),
+                               num_segments=8).astype(jnp.uint32)
+
+
+def per_feature_split_categorical(
+        hist: jnp.ndarray,          # [F, B, 3]
+        meta: FeatureMeta,
+        params: SplitParams,
+        sum_grad: jnp.ndarray,
+        sum_hess: jnp.ndarray,
+        num_data: jnp.ndarray,
+        feature_mask: jnp.ndarray,
+        min_constraint: float | jnp.ndarray = -jnp.inf,
+        max_constraint: float | jnp.ndarray = jnp.inf,
+) -> Tuple[PerFeatureSplit, jnp.ndarray]:
+    """Vectorized FindBestThresholdCategorical
+    (feature_histogram.hpp:110-271).
+
+    Two candidate generators, selected per feature by
+    ``num_bin <= max_cat_to_onehot``:
+
+    - one-vs-rest: every real category bin t as left = {t};
+    - sorted-subset: bins with count >= cat_smooth sorted by
+      sum_grad/(sum_hess + cat_smooth); prefix scans from both ends, at most
+      min(max_cat_threshold, (used+1)/2) categories, evaluating only when the
+      accumulated group reaches min_data_per_group, with l2 += cat_l2.
+
+    Bin 0 is this framework's catch-all (unseen categories / NaN,
+    binning.py:_find_bin_categorical) and always stays on the right — the
+    raw-value bitset could not express "unknown goes left" at predict time.
+
+    Returns per-feature best splits plus [F, 8] uint32 bin-space bitsets of
+    the categories going left.
+    """
+    f, b, _ = hist.shape
+    sp = params
+    sum_hess = sum_hess + 2 * K_EPSILON
+    bins = jnp.arange(b, dtype=jnp.int32)
+
+    gain_shift = leaf_split_gain(sum_grad, sum_hess, sp.lambda_l1,
+                                 sp.lambda_l2, sp.max_delta_step)
+    min_gain_shift = gain_shift + sp.min_gain_to_split
+    l2_cat = sp.lambda_l2 + sp.cat_l2
+
+    def one_feature(hist_f, num_bin):
+        is_real = (bins >= 1) & (bins < num_bin)
+        g = jnp.where(is_real, hist_f[:, 0], 0.0)
+        h = jnp.where(is_real, hist_f[:, 1], 0.0)
+        c = jnp.where(is_real, hist_f[:, 2], 0.0)
+
+        # ---- one-vs-rest (use_onehot branch, :130-161) -------------------
+        oh_g = sum_grad - g
+        oh_h = sum_hess - h - K_EPSILON
+        oh_c = num_data - c
+        ok1 = (is_real & (c >= sp.min_data_in_leaf)
+               & (h >= sp.min_sum_hessian_in_leaf)
+               & (oh_c >= sp.min_data_in_leaf)
+               & (oh_h >= sp.min_sum_hessian_in_leaf))
+        gain1, lo1, ro1 = _split_gains_l2(
+            g, h + K_EPSILON, oh_g, oh_h, sp, sp.lambda_l2,
+            min_constraint, max_constraint)
+        gain1 = jnp.where(ok1 & (gain1 > min_gain_shift), gain1, K_MIN_SCORE)
+        t1 = jnp.argmax(gain1)
+        onehot = dict(
+            gain=gain1[t1], lg=g[t1], lh=h[t1], lc=c[t1],
+            lo=lo1[t1], ro=ro1[t1], member=bins == t1)
+
+        # ---- sorted-subset scan (:162-235) -------------------------------
+        elig = is_real & (c >= sp.cat_smooth)
+        n_elig = jnp.sum(elig.astype(jnp.int32))
+        ctr = g / (h + sp.cat_smooth)
+        max_num_cat = jnp.minimum(sp.max_cat_threshold, (n_elig + 1) // 2)
+
+        def one_direction(key):
+            order = jnp.argsort(key)
+            gs, hs, cs = g[order], h[order], c[order]
+            pg = jnp.cumsum(gs)
+            ph = jnp.cumsum(hs) + K_EPSILON
+            pc = jnp.cumsum(cs)
+            i = jnp.arange(b)
+            in_range = (i < max_num_cat) & (i < n_elig)
+            left_ok = (pc >= sp.min_data_in_leaf) \
+                & (ph >= sp.min_sum_hessian_in_leaf)
+            rc = num_data - pc
+            rh = sum_hess - ph
+            stop = (rc < sp.min_data_in_leaf) | (rc < sp.min_data_per_group) \
+                | (rh < sp.min_sum_hessian_in_leaf)
+            # `break` fires only when reached (left_ok passed), killing the
+            # current position and everything after (:204-210)
+            alive = jnp.cumsum((left_ok & stop).astype(jnp.int32)) == 0
+            can = in_range & alive & left_ok
+
+            def gstep(cnt_group, inp):
+                cs_i, can_i = inp
+                cnt_group = cnt_group + cs_i
+                do_eval = can_i & (cnt_group >= sp.min_data_per_group)
+                return jnp.where(do_eval, 0.0, cnt_group), do_eval
+
+            _, do_eval = jax.lax.scan(gstep, jnp.asarray(0.0), (cs, can))
+            gain2, lo2, ro2 = _split_gains_l2(
+                pg, ph, sum_grad - pg, sum_hess - ph, sp, l2_cat,
+                min_constraint, max_constraint)
+            gain2 = jnp.where(do_eval & (gain2 > min_gain_shift), gain2,
+                              K_MIN_SCORE)
+            ib = jnp.argmax(gain2)
+            inv_rank = jnp.argsort(order)
+            member = (inv_rank <= ib) & elig
+            return dict(gain=gain2[ib], lg=pg[ib], lh=ph[ib] - K_EPSILON,
+                        lc=pc[ib], lo=lo2[ib], ro=ro2[ib], member=member)
+
+        asc = one_direction(jnp.where(elig, ctr, jnp.inf))
+        desc = one_direction(jnp.where(elig, -ctr, jnp.inf))
+        sorted_best = jax.tree.map(
+            lambda a_, d_: jnp.where(asc["gain"] >= desc["gain"], a_, d_),
+            asc, desc)
+
+        use_onehot = num_bin <= sp.max_cat_to_onehot
+        return jax.tree.map(
+            lambda o, s_: jnp.where(use_onehot, o, s_), onehot, sorted_best)
+
+    res = jax.vmap(one_feature)(hist, meta.num_bin)
+    usable = feature_mask & meta.is_categorical & (meta.num_bin > 1)
+    out_gain = jnp.where(usable & jnp.isfinite(res["gain"]),
+                         (res["gain"] - min_gain_shift) * meta.penalty,
+                         K_MIN_SCORE)
+    bitsets = jax.vmap(_bin_membership_bitset)(res["member"])
+    pf = PerFeatureSplit(
+        gain=out_gain,
+        threshold=jnp.zeros((f,), jnp.int32),
+        default_left=jnp.zeros((f,), bool),
+        left_sum_grad=res["lg"],
+        left_sum_hess=res["lh"],
+        left_count=res["lc"],
+        left_output=res["lo"],
+        right_output=res["ro"],
+    )
+    return pf, bitsets
+
+
+def find_best_split(
+        hist: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
+        sum_grad: jnp.ndarray, sum_hess: jnp.ndarray, num_data: jnp.ndarray,
+        feature_mask: jnp.ndarray,
+        min_constraint: float | jnp.ndarray = -jnp.inf,
+        max_constraint: float | jnp.ndarray = jnp.inf,
+        with_categorical: bool = False,
+) -> BestSplit:
+    """Best split over all features, numerical and (when the dataset has any)
+    categorical — the per-leaf SplitInfo argmax
+    (serial_tree_learner.cpp:506-591)."""
+    pf, bitsets = per_feature_split_merged(
+        hist, meta, params, sum_grad, sum_hess, num_data, feature_mask,
+        min_constraint, max_constraint, with_categorical)
+    best_f = jnp.argmax(pf.gain).astype(jnp.int32)
+    sel = lambda a: a[best_f]
+    gain = pf.gain[best_f]
+    splittable = jnp.isfinite(gain)
+    return BestSplit(
+        gain=jnp.where(splittable, gain, K_MIN_SCORE),
+        feature=best_f,
+        threshold=sel(pf.threshold),
+        default_left=sel(pf.default_left),
+        left_sum_grad=sel(pf.left_sum_grad),
+        left_sum_hess=sel(pf.left_sum_hess),
+        left_count=sel(pf.left_count),
+        right_sum_grad=sum_grad - sel(pf.left_sum_grad),
+        right_sum_hess=sum_hess - sel(pf.left_sum_hess),
+        right_count=num_data - sel(pf.left_count),
+        left_output=sel(pf.left_output),
+        right_output=sel(pf.right_output),
+        is_categorical=meta.is_categorical[best_f],
+        cat_bitset=bitsets[best_f],
+    )
+
+
+def per_feature_split_merged(
+        hist: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
+        sum_grad: jnp.ndarray, sum_hess: jnp.ndarray, num_data: jnp.ndarray,
+        feature_mask: jnp.ndarray,
+        min_constraint: float | jnp.ndarray = -jnp.inf,
+        max_constraint: float | jnp.ndarray = jnp.inf,
+        with_categorical: bool = False,
+) -> Tuple[PerFeatureSplit, jnp.ndarray]:
+    """Per-feature best splits, each feature using its own finder
+    (FindBestThreshold dispatch, feature_histogram.hpp:68-108)."""
+    f = hist.shape[0]
+    pf = per_feature_split_numerical(
+        hist, meta, params, sum_grad, sum_hess, num_data, feature_mask,
+        None, min_constraint, max_constraint)
+    if not with_categorical:
+        return pf, jnp.zeros((f, 8), jnp.uint32)
+    pfc, bitsets = per_feature_split_categorical(
+        hist, meta, params, sum_grad, sum_hess, num_data, feature_mask,
+        min_constraint, max_constraint)
+    is_cat = meta.is_categorical
+    merged = PerFeatureSplit(*[
+        jnp.where(is_cat, cv, nv) for nv, cv in zip(pf, pfc)])
+    bitsets = jnp.where(is_cat[:, None], bitsets, 0).astype(jnp.uint32)
+    return merged, bitsets

@@ -40,16 +40,23 @@ class PredictTree(NamedTuple):
     leaf_value: jnp.ndarray      # [L] f32
 
 
-def pack_predict_table(ht, max_nodes: int, max_leaves: int) -> "PredictTree":
+def pack_predict_table(ht, max_nodes: int, max_leaves: int,
+                       cat_words: Optional[int] = None) -> "PredictTree":
     """Pad a host tree's SoA arrays to model-wide fixed shapes for stacked
     device prediction. ``ht`` is any object with the HostTree field layout
-    (boosting.gbdt.HostTree or io.model_text.LoadedTree)."""
+    (boosting.gbdt.HostTree or io.model_text.LoadedTree). ``cat_words``
+    widens the categorical bitset so trees with different raw-category
+    ranges stack (Tree cat_threshold_ is variable-width, tree.h:276-291)."""
     import numpy as np
 
     def pad(a, n, fill=0):
         out = np.full((n,) + a.shape[1:], fill, a.dtype)
         out[:len(a)] = a
         return out
+
+    bitset = ht.cat_bitset
+    if cat_words is not None and bitset.shape[1] < cat_words:
+        bitset = np.pad(bitset, ((0, 0), (0, cat_words - bitset.shape[1])))
 
     return PredictTree(
         split_leaf=pad(ht.split_leaf, max_nodes, -1),
@@ -59,7 +66,7 @@ def pack_predict_table(ht, max_nodes: int, max_leaves: int) -> "PredictTree":
         default_left=pad(ht.default_left, max_nodes),
         missing_type=pad(ht.missing_type, max_nodes),
         is_categorical=pad(ht.is_categorical, max_nodes),
-        cat_bitset=pad(ht.cat_bitset, max_nodes),
+        cat_bitset=pad(bitset, max_nodes),
         leaf_value=pad(ht.leaf_value.astype(np.float32), max_leaves),
     )
 
@@ -77,9 +84,10 @@ def _raw_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
         missing_type == MISSING_NAN, is_nan,
         jnp.where(missing_type == MISSING_ZERO, is_zero | is_nan, False))
     numerical = jnp.where(use_default, default_left, fval_safe <= threshold)
-    cat_i = jnp.clip(fval_safe, 0, 255).astype(jnp.int32)
+    max_cat = cat_bitset.shape[0] * 32     # variable-width bitset
+    cat_i = jnp.clip(fval_safe, 0, max_cat - 1).astype(jnp.int32)
     word = cat_bitset[cat_i >> 5]
-    cat_ok = (~is_nan) & (fval >= 0) & (fval < 256)
+    cat_ok = (~is_nan) & (fval >= 0) & (fval < max_cat)
     categorical = cat_ok & (((word >> (cat_i & 31).astype(jnp.uint32)) & 1) == 1)
     return jnp.where(is_cat, categorical, numerical)
 

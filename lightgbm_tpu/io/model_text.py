@@ -231,9 +231,13 @@ class LoadedTree:
         thr_tokens = kv.get("threshold", "").split() if nn else []
         self.threshold = np.zeros(nn, np.float64)
         self.threshold_bin = np.zeros(nn, np.int32)
-        self.cat_bitset = np.zeros((nn, 8), np.uint32)
+        cat_words = 8
         if num_cat > 0:
             boundaries = arr("cat_boundaries", np.int64, num_cat + 1)
+            cat_words = max(cat_words,
+                            int(np.max(np.diff(boundaries), initial=0)))
+        self.cat_bitset = np.zeros((max(nn, 1), cat_words), np.uint32)
+        if num_cat > 0:
             words = arr("cat_threshold", np.int64, 0) \
                 if not kv.get("cat_threshold", "").strip() else \
                 np.array(kv["cat_threshold"].split(), np.int64)
@@ -263,9 +267,10 @@ class LoadedTree:
     def num_nodes(self) -> int:
         return self.num_leaves_actual - 1
 
-    def predict_table(self, max_nodes: int, max_leaves: int):
+    def predict_table(self, max_nodes: int, max_leaves: int, cat_words=None):
         from ..core import tree as tree_mod
-        return tree_mod.pack_predict_table(self, max_nodes, max_leaves)
+        return tree_mod.pack_predict_table(self, max_nodes, max_leaves,
+                                           cat_words)
 
 
 def parse_model_string(model_str: str) -> Dict:
@@ -413,14 +418,16 @@ def model_to_cpp(parsed: Dict) -> str:
             missing = int(ht.missing_type[index])
             dl = bool(ht.default_left[index])
             if ht.is_categorical[index]:
+                nw = ht.cat_bitset.shape[1]
                 words = ", ".join("0x%xu" % int(w)
                                   for w in ht.cat_bitset[index])
                 lines.append(
-                    "%s{ static const unsigned cat[8] = {%s};" % (pad, words))
+                    "%s{ static const unsigned cat[%d] = {%s};"
+                    % (pad, nw, words))
                 lines.append("%s  int c = (int)arr[%d];" % (pad, f))
                 lines.append(
-                    "%s  if (!std::isnan(arr[%d]) && c >= 0 && c < 256 && "
-                    "((cat[c >> 5] >> (c & 31)) & 1)) {" % (pad, f))
+                    "%s  if (!std::isnan(arr[%d]) && c >= 0 && c < %d && "
+                    "((cat[c >> 5] >> (c & 31)) & 1)) {" % (pad, f, nw * 32))
                 closer = "} }"
             else:
                 thr = float(ht.threshold[index])
@@ -463,6 +470,11 @@ def model_to_cpp(parsed: Dict) -> str:
     lines.append("")
     lines.append('extern "C" void Predict(const double* arr, double* out) {')
     lines.append("  PredictRaw(arr, out);")
+    if "sqrt" in obj[1:]:
+        # reg_sqrt back-transform: sign(x) * x^2 (regression_objective.hpp)
+        lines.append("  for (int c = 0; c < %d; ++c) "
+                     "out[c] = (out[c] < 0 ? -1.0 : 1.0) * out[c] * out[c];"
+                     % k)
     if obj_name in ("binary", "cross_entropy", "xentropy"):
         lines.append("  out[0] = 1.0 / (1.0 + std::exp(%.17g * -out[0]));"
                      % sigmoid)

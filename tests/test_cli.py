@@ -30,8 +30,10 @@ def data_files(tmp_path_factory):
 
 
 def test_kv2map_and_config_file(tmp_path):
-    assert kv2map(["a=1", "b = x", "# comment", "c=2 # tail"]) == \
-        {"a": "1", "b": "x", "c": "2"}
+    assert kv2map(["a=1", "b = x", "# comment", "c=2 # tail"],
+                  strip_comments=True) == {"a": "1", "b": "x", "c": "2"}
+    # command-line values keep '#' (only config files have comments)
+    assert kv2map(["data=run#3/train.csv"]) == {"data": "run#3/train.csv"}
     conf = tmp_path / "t.conf"
     conf.write_text("task = train\nnum_trees = 7\n# comment\ndata=d.csv\n")
     params = load_parameters(["config=%s" % conf, "num_trees=9"])

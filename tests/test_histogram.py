@@ -87,3 +87,24 @@ def test_fix_histogram_restores_totals():
     np.testing.assert_allclose(fixed[:, :, 0].sum(1), sg, rtol=1e-5)
     np.testing.assert_allclose(fixed[:, :, 1].sum(1), sh, rtol=1e-5)
     np.testing.assert_allclose(fixed[:, :, 2].sum(1), cnt, rtol=1e-5)
+
+
+def test_pallas_kernel_matches_scatter():
+    """The Pallas TPU histogram kernel (core/histogram_pallas.py), in
+    interpreter mode on CPU, must match the scatter reference exactly —
+    the GPU_DEBUG_COMPARE check (gpu_tree_learner.cpp:992-1010) as a test."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.histogram import build_histogram
+    r = np.random.RandomState(3)
+    for (n, f, b) in [(700, 5, 16), (1500, 13, 256), (513, 8, 64)]:
+        xb = r.randint(0, b, (n, f)).astype(np.uint8)
+        g = r.randn(n).astype(np.float32)
+        h = np.abs(r.randn(n)).astype(np.float32)
+        m = (r.rand(n) > 0.4).astype(np.float32)
+        ref = np.asarray(build_histogram(
+            jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+            num_bins=b, impl="scatter"))
+        pal = np.asarray(build_histogram(
+            jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+            num_bins=b, impl="pallas_interpret"))
+        np.testing.assert_allclose(pal, ref, rtol=1e-4, atol=1e-3)

@@ -215,3 +215,64 @@ def test_feature_importance_counts_splits():
     assert imp.sum() == total_splits
     gain_imp = b.feature_importance("gain")
     assert gain_imp.sum() > 0
+
+
+def test_categorical_splits_improve_fit():
+    """Categorical split finding (FindBestThresholdCategorical,
+    feature_histogram.hpp:110-271): a feature whose categories carry signal
+    in a non-ordinal way must be exploited via subset splits. Reference
+    test: test_engine.py:218-291."""
+    r = np.random.RandomState(5)
+    n = 3000
+    cat = r.randint(0, 12, n)
+    x2 = r.randn(n)
+    # non-ordinal category effect: {1,3,5,8} high, rest low
+    effect = np.where(np.isin(cat, [1, 3, 5, 8]), 2.0, -2.0)
+    y = (effect + 0.5 * x2 + 0.3 * r.randn(n) > 0).astype(np.float64)
+    X = np.column_stack([cat.astype(np.float64), x2])
+
+    b_cat, _ = _train(X, y, {"objective": "binary", "verbosity": -1,
+                             "categorical_feature": "0",
+                             "min_data_per_group": 10}, rounds=15)
+    from sklearn.metrics import roc_auc_score
+    auc_cat = roc_auc_score(y, b_cat.predict(X))
+    assert auc_cat > 0.97
+
+    # one-hot mode (small cardinality): max_cat_to_onehot above num_bin
+    b_oh, _ = _train(X, y, {"objective": "binary", "verbosity": -1,
+                            "categorical_feature": "0",
+                            "max_cat_to_onehot": 32}, rounds=15)
+    assert roc_auc_score(y, b_oh.predict(X)) > 0.95
+
+    # save -> load -> predict round-trip with categorical splits
+    import lightgbm_tpu as lgb
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "categorical_feature": "0", "min_data_per_group": 10},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    s = bst.model_to_string()
+    assert "num_cat=" in s
+    re = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(re.predict(X[:200]), bst.predict(X[:200]),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_categorical_large_values_roundtrip():
+    """Category IDs above 255 (store/zip-style) must survive training,
+    raw prediction, and save/load — variable-width bitsets
+    (Tree cat_threshold_, tree.h:276-291)."""
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(9)
+    n = 2500
+    ids = np.array([7, 300, 999, 1204, 55, 801])
+    cat = ids[r.randint(0, len(ids), n)]
+    y = (np.isin(cat, [300, 1204]) ^ (r.rand(n) < 0.05)).astype(float)
+    X = np.column_stack([cat.astype(np.float64), r.randn(n)])
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "categorical_feature": "0", "min_data_per_group": 10,
+                     "max_cat_to_onehot": 16},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.97
+    re = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(re.predict(X[:300]), bst.predict(X[:300]),
+                               rtol=1e-6, atol=1e-9)
