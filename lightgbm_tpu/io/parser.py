@@ -51,6 +51,38 @@ def _resolve_label_idx(label_column: str, header_names: Optional[List[str]]) -> 
     return int(label_column)
 
 
+def _try_parse_native(path: str, has_header: bool, label_column: str
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                          Optional[List[str]]]]:
+    """Use the C++ parser (native/src/text_parser.cpp) when available —
+    the reference's C++ parsing stack behind its C API; Python fallback
+    otherwise."""
+    from ..native import parse_file_native
+    label_idx = 0
+    header_names = None
+    if label_column.startswith("name:"):
+        # need the header to resolve the index before the native call
+        with open(path, "r") as fh:
+            first = fh.readline().strip()
+        delim = "\t" if "\t" in first else ("," if "," in first else " ")
+        header_names = first.split(delim)
+        label_idx = _resolve_label_idx(label_column, header_names)
+    elif label_column:
+        label_idx = int(label_column)
+    try:
+        res = parse_file_native(path, has_header, label_idx)
+    except Exception as e:
+        if type(e).__name__ == "LightGBMError":
+            raise
+        return None
+    if res is None:
+        return None
+    X, y, tokens, fmt = res
+    if tokens is not None and fmt == 0 and label_idx < len(tokens):
+        tokens = [t for i, t in enumerate(tokens) if i != label_idx]
+    return X, y, tokens
+
+
 def parse_file(path: str, has_header: bool = False, label_column: str = "",
                max_lines: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
@@ -61,6 +93,10 @@ def parse_file(path: str, has_header: bool = False, label_column: str = "",
     LibSVM.
     """
     check(os.path.exists(path), "Data file %s doesn't exist" % path)
+    if max_lines is None:
+        native = _try_parse_native(path, has_header, label_column)
+        if native is not None:
+            return native
     with open(path, "r") as fh:
         lines = fh.read().splitlines()
     if max_lines is not None:

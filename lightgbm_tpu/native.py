@@ -1,0 +1,114 @@
+"""ctypes bridge to the native (C++) host runtime.
+
+The reference keeps its data plane in C++ behind a C ABI consumed by the
+bindings (src/c_api.cpp, python-package _load_lib basic.py:25); this module
+is that seam for lightgbm_tpu. The shared library is built on demand from
+``native/`` with the baked-in toolchain; every entry point has a pure-Python
+fallback, so a missing compiler only costs speed, never functionality.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .log import Log
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_NAME = "liblgbm_tpu_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+class _ParseResult(ctypes.Structure):
+    _fields_ = [("data", ctypes.POINTER(ctypes.c_double)),
+                ("label", ctypes.POINTER(ctypes.c_double)),
+                ("rows", ctypes.c_long),
+                ("cols", ctypes.c_long),
+                ("header", ctypes.c_char_p),
+                ("format", ctypes.c_int)]
+
+
+def _build() -> Optional[str]:
+    so = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    src = os.path.join(_NATIVE_DIR, "src", "text_parser.cpp")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    try:
+        r = subprocess.run(["make", "-C", _NATIVE_DIR],
+                           capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            Log.warning("native build failed, using Python fallbacks:\n%s",
+                        r.stderr[-500:])
+            return None
+    except Exception as e:  # no make/g++ — pure-Python mode
+        Log.warning("native build unavailable (%s); using Python fallbacks", e)
+        return None
+    return so if os.path.exists(so) else None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.LGBMT_ParseFile.restype = ctypes.c_int
+            lib.LGBMT_ParseFile.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(_ParseResult), ctypes.c_char_p, ctypes.c_int]
+            lib.LGBMT_FreeParseResult.argtypes = [ctypes.POINTER(_ParseResult)]
+            _lib = lib
+        except OSError as e:
+            Log.warning("cannot load native library: %s", e)
+            _lib = None
+        return _lib
+
+
+def parse_file_native(path: str, has_header: bool, label_idx: int
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                          Optional[List[str]], int]]:
+    """Parse a data file with the C++ parser.
+
+    Returns (X [N, F] float64, label [N], header tokens or None, format) or
+    None when the native library is unavailable (caller falls back).
+    Raises on parse errors reported by the library.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    res = _ParseResult()
+    err = ctypes.create_string_buffer(512)
+    rc = lib.LGBMT_ParseFile(path.encode(), int(has_header), int(label_idx),
+                             ctypes.byref(res), err, len(err))
+    if rc != 0:
+        from .log import LightGBMError
+        raise LightGBMError(err.value.decode())
+    try:
+        n, f = int(res.rows), int(res.cols)
+        X = np.ctypeslib.as_array(res.data, shape=(n, f)).copy()
+        y = np.ctypeslib.as_array(res.label, shape=(n,)).copy()
+        header = res.header.decode() if res.header else None
+        fmt = int(res.format)
+    finally:
+        lib.LGBMT_FreeParseResult(ctypes.byref(res))
+    tokens = None
+    if header is not None:
+        delim = "\t" if "\t" in header else ("," if "," in header else " ")
+        tokens = header.strip().split(delim)
+    return X, y, tokens, fmt

@@ -1,0 +1,289 @@
+// Native text-data loader for lightgbm_tpu.
+//
+// TPU-native equivalent of the reference's host-side parsing stack
+// (src/io/parser.cpp CSVParser/TSVParser/LibSVMParser + utils/text_reader.h
+// chunked reading): one mmap-free bulk read, line index built serially,
+// then OpenMP-parallel per-line numeric parsing into a dense row-major
+// float64 matrix. Exposed through a minimal C ABI consumed via ctypes
+// (the reference exposes its loaders through c_api.cpp the same way).
+//
+// Behavioral contract (mirrors lightgbm_tpu/io/parser.py):
+// - format auto-detection from the first non-empty lines: LibSVM when
+//   index:value tokens are present, else delimiter = tab > comma > space;
+// - delimited: the label column (by index) is split out; malformed or
+//   empty fields parse as NaN;
+// - LibSVM: leading token is the label; feature ids are 0-based column
+//   indices into the dense output (missing entries are 0).
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct LineIndex {
+  const char* begin;
+  const char* end;
+};
+
+// Build line table, skipping blank lines.
+static std::vector<LineIndex> IndexLines(const char* buf, size_t len) {
+  std::vector<LineIndex> lines;
+  const char* p = buf;
+  const char* file_end = buf + len;
+  while (p < file_end) {
+    const char* eol = static_cast<const char*>(memchr(p, '\n', file_end - p));
+    const char* end = eol ? eol : file_end;
+    const char* e = end;
+    while (e > p && (e[-1] == '\r' || e[-1] == ' ')) --e;
+    const char* s = p;
+    while (s < e && (*s == ' ' || *s == '\t')) ++s;
+    if (s < e) lines.push_back({p, end});
+    p = eol ? eol + 1 : file_end;
+  }
+  return lines;
+}
+
+static bool LooksLikeLibsvm(const LineIndex& ln) {
+  // any token after the first containing ':' with digits on the left
+  const char* p = ln.begin;
+  bool first = true;
+  while (p < ln.end) {
+    while (p < ln.end && (*p == ' ' || *p == '\t')) ++p;
+    const char* tok = p;
+    while (p < ln.end && *p != ' ' && *p != '\t') ++p;
+    if (!first) {
+      for (const char* q = tok; q < p; ++q) {
+        if (*q == ':') return true;
+      }
+    }
+    first = false;
+  }
+  return false;
+}
+
+static char DetectDelim(const LineIndex* lines, size_t count,
+                        size_t n_probe) {
+  size_t tabs = 0, commas = 0;
+  for (size_t i = 0; i < n_probe && i < count; ++i) {
+    for (const char* p = lines[i].begin; p < lines[i].end; ++p) {
+      if (*p == '\t') ++tabs;
+      else if (*p == ',') ++commas;
+    }
+  }
+  if (tabs > 0) return '\t';
+  if (commas > 0) return ',';
+  return ' ';
+}
+
+static double ParseField(const char* s, const char* e) {
+  while (s < e && (*s == ' ' || *s == '\t')) ++s;
+  while (e > s && (e[-1] == ' ' || e[-1] == '\t')) --e;
+  if (s >= e) return NAN;
+  char tmp[64];
+  size_t n = static_cast<size_t>(e - s);
+  if (n >= sizeof(tmp)) n = sizeof(tmp) - 1;
+  memcpy(tmp, s, n);
+  tmp[n] = '\0';
+  char* endp = nullptr;
+  double v = strtod(tmp, &endp);
+  if (endp == tmp) return NAN;
+  return v;
+}
+
+static int CountFields(const LineIndex& ln, char delim) {
+  int n = 1;
+  for (const char* p = ln.begin; p < ln.end; ++p) {
+    if (*p == delim) ++n;
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct LGBMTParseResult {
+  double* data;    // rows x cols row-major feature matrix (label removed)
+  double* label;   // rows
+  long rows;
+  long cols;       // feature columns (excluding label)
+  char* header;    // header line copy ('\0'-terminated) or nullptr
+  int format;      // 0 = delimited, 1 = libsvm
+};
+
+void LGBMT_FreeParseResult(LGBMTParseResult* r) {
+  if (!r) return;
+  free(r->data); r->data = nullptr;
+  free(r->label); r->label = nullptr;
+  free(r->header); r->header = nullptr;
+}
+
+// Returns 0 on success; on failure a message is written to errbuf.
+int LGBMT_ParseFile(const char* path, int has_header, int label_idx,
+                    LGBMTParseResult* out, char* errbuf, int errlen) {
+  out->data = nullptr; out->label = nullptr; out->header = nullptr;
+  out->rows = 0; out->cols = 0; out->format = 0;
+
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    snprintf(errbuf, errlen, "cannot open %s", path);
+    return 1;
+  }
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string buf;
+  buf.resize(static_cast<size_t>(fsize));
+  if (fsize > 0 && fread(&buf[0], 1, fsize, f) != static_cast<size_t>(fsize)) {
+    fclose(f);
+    snprintf(errbuf, errlen, "short read on %s", path);
+    return 1;
+  }
+  fclose(f);
+
+  std::vector<LineIndex> lines = IndexLines(buf.data(), buf.size());
+  if (lines.empty()) {
+    snprintf(errbuf, errlen, "data file %s is empty", path);
+    return 1;
+  }
+
+  size_t first_data = 0;
+  if (has_header) {
+    const LineIndex& h = lines[0];
+    size_t hl = static_cast<size_t>(h.end - h.begin);
+    out->header = static_cast<char*>(malloc(hl + 1));
+    memcpy(out->header, h.begin, hl);
+    out->header[hl] = '\0';
+    first_data = 1;
+  }
+  if (lines.size() <= first_data) {
+    snprintf(errbuf, errlen, "data file %s has no data rows", path);
+    return 1;
+  }
+  const long rows = static_cast<long>(lines.size() - first_data);
+  const LineIndex* data_lines = lines.data() + first_data;
+
+  bool libsvm = false;
+  for (size_t i = 0; i < 10 && i < static_cast<size_t>(rows); ++i) {
+    if (LooksLikeLibsvm(data_lines[i])) { libsvm = true; break; }
+  }
+
+  if (libsvm) {
+    out->format = 1;
+    // pass 1: max feature index (parallel reduce)
+    long max_idx = -1;
+#ifdef _OPENMP
+#pragma omp parallel for reduction(max : max_idx) schedule(static)
+#endif
+    for (long i = 0; i < rows; ++i) {
+      const char* p = data_lines[i].begin;
+      const char* e = data_lines[i].end;
+      bool first = true;
+      while (p < e) {
+        while (p < e && (*p == ' ' || *p == '\t')) ++p;
+        const char* tok = p;
+        while (p < e && *p != ' ' && *p != '\t') ++p;
+        if (!first) {
+          const char* colon = static_cast<const char*>(
+              memchr(tok, ':', p - tok));
+          if (colon) {
+            long k = strtol(tok, nullptr, 10);
+            if (k > max_idx) max_idx = k;
+          }
+        }
+        first = false;
+      }
+    }
+    const long cols = max_idx + 1;
+    out->rows = rows; out->cols = cols;
+    out->data = static_cast<double*>(calloc(static_cast<size_t>(rows) * cols,
+                                            sizeof(double)));
+    out->label = static_cast<double*>(malloc(rows * sizeof(double)));
+    if (!out->data || !out->label) {
+      LGBMT_FreeParseResult(out);
+      snprintf(errbuf, errlen, "out of memory for %ld x %ld", rows, cols);
+      return 1;
+    }
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (long i = 0; i < rows; ++i) {
+      const char* p = data_lines[i].begin;
+      const char* e = data_lines[i].end;
+      double* row = out->data + static_cast<size_t>(i) * cols;
+      bool first = true;
+      while (p < e) {
+        while (p < e && (*p == ' ' || *p == '\t')) ++p;
+        const char* tok = p;
+        while (p < e && *p != ' ' && *p != '\t') ++p;
+        if (tok >= p) continue;
+        if (first) {
+          out->label[i] = ParseField(tok, p);
+          first = false;
+        } else {
+          const char* colon = static_cast<const char*>(
+              memchr(tok, ':', p - tok));
+          if (colon) {
+            long k = strtol(tok, nullptr, 10);
+            if (k >= 0 && k < cols) row[k] = ParseField(colon + 1, p);
+          }
+        }
+      }
+    }
+    return 0;
+  }
+
+  // delimited
+  char delim = DetectDelim(data_lines, rows, 10);
+  int total_cols = CountFields(data_lines[0], delim);
+  if (label_idx < 0 || label_idx >= total_cols) {
+    snprintf(errbuf, errlen, "label column %d out of range (%d columns)",
+             label_idx, total_cols);
+    return 1;
+  }
+  const long cols = total_cols - 1;
+  out->rows = rows; out->cols = cols;
+  out->data = static_cast<double*>(malloc(static_cast<size_t>(rows) * cols *
+                                          sizeof(double)));
+  out->label = static_cast<double*>(malloc(rows * sizeof(double)));
+  if (!out->data || !out->label) {
+    LGBMT_FreeParseResult(out);
+    snprintf(errbuf, errlen, "out of memory for %ld x %ld", rows, cols);
+    return 1;
+  }
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (long i = 0; i < rows; ++i) {
+    const char* p = data_lines[i].begin;
+    const char* e = data_lines[i].end;
+    double* row = out->data + static_cast<size_t>(i) * cols;
+    int col = 0, fcol = 0;
+    while (col < total_cols) {
+      const char* field_end = static_cast<const char*>(
+          memchr(p, delim, e - p));
+      if (!field_end) field_end = e;
+      double v = ParseField(p, field_end);
+      if (col == label_idx) {
+        out->label[i] = v;
+      } else {
+        row[fcol++] = v;
+      }
+      ++col;
+      p = field_end < e ? field_end + 1 : e;
+    }
+    while (fcol < cols) row[fcol++] = NAN;  // ragged short row
+  }
+  return 0;
+}
+
+}  // extern "C"

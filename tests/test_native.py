@@ -1,0 +1,78 @@
+"""Native (C++) host runtime: parser parity with the pure-Python path
+(the reference validates its C++ loaders end-to-end through the bindings,
+SURVEY.md §4; here the two implementations check each other)."""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import native
+from lightgbm_tpu.io import parser
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("native")
+    r = np.random.RandomState(0)
+    X = r.randn(2000, 5)
+    y = r.randint(0, 2, 2000)
+    p = str(d / "data.csv")
+    with open(p, "w") as fh:
+        fh.write("label,a,b,c,d,e\n")
+        for xi, yi in zip(X, y):
+            vals = ["%g" % v for v in xi]
+            if r.rand() < 0.02:
+                vals[1] = ""          # missing -> NaN
+            fh.write("%d," % yi + ",".join(vals) + "\n")
+    return p, X, y
+
+
+def test_native_lib_builds():
+    assert native.get_lib() is not None, \
+        "native library failed to build (g++ is baked into the image)"
+
+
+def test_native_csv_matches_python(csv_file):
+    p, X, y = csv_file
+    Xn, yn, names = parser.parse_file(p, has_header=True)
+    # max_lines forces the pure-Python path
+    Xp, yp, names_p = parser.parse_file(p, has_header=True, max_lines=10**9)
+    assert names == names_p == ["a", "b", "c", "d", "e"]
+    np.testing.assert_array_equal(yn, yp)
+    np.testing.assert_allclose(np.nan_to_num(Xn, nan=-9e9),
+                               np.nan_to_num(Xp, nan=-9e9))
+
+
+def test_native_libsvm(tmp_path):
+    r = np.random.RandomState(1)
+    X = r.randn(500, 7)
+    y = r.randint(0, 2, 500)
+    p = str(tmp_path / "d.svm")
+    with open(p, "w") as fh:
+        for xi, yi in zip(X, y):
+            toks = ["%d" % yi] + ["%d:%g" % (j, v)
+                                  for j, v in enumerate(xi) if abs(v) > 0.3]
+            fh.write(" ".join(toks) + "\n")
+    Xn, yn, _ = parser.parse_file(p)
+    Xp, yp, _ = parser.parse_file(p, max_lines=10**9)
+    assert Xn.shape == Xp.shape
+    np.testing.assert_array_equal(yn, yp)
+    np.testing.assert_allclose(Xn, Xp)
+
+
+def test_native_label_by_name(csv_file):
+    p, X, y = csv_file
+    Xn, yn, names = parser.parse_file(p, has_header=True,
+                                      label_column="name:label")
+    np.testing.assert_array_equal(yn, y.astype(np.float64))
+
+
+def test_native_weight_query_sidecars_still_python(tmp_path):
+    # sidecar loaders stay in Python; just exercise them
+    p = str(tmp_path / "t.csv")
+    with open(p, "w") as fh:
+        fh.write("1,2,3\n0,4,5\n")
+    with open(p + ".weight", "w") as fh:
+        fh.write("0.5\n2.0\n")
+    w = parser.load_weight_file(p)
+    np.testing.assert_allclose(w, [0.5, 2.0])
