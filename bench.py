@@ -112,6 +112,13 @@ def run_bench(backend_info: dict) -> dict:
     iters_per_sec = iters / dt
     higgs_equiv = iters_per_sec * (n / HIGGS_ROWS)
     vs_baseline = higgs_equiv / BASELINE_ITERS_PER_SEC
+    phases = {}
+    if os.environ.get("BENCH_PHASES", "1") != "0":
+        try:
+            from lightgbm_tpu.profiling import phase_probe
+            phases = phase_probe(b)
+        except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
+            phases = {"probe_error": str(e)[:200]}
     return {
         "metric": "boosting_iters_per_sec_higgs_equivalent "
                   "(binary GBDT, %dk rows x %d feat, %d leaves, 255 bins)"
@@ -126,7 +133,8 @@ def run_bench(backend_info: dict) -> dict:
         "rows_features_per_sec_per_chip": round(iters_per_sec * n * f, 1),
         "phase_seconds": {"binning": round(t_bin, 3),
                           "compile_and_warmup": round(t_compile_warmup, 3),
-                          "train_%d_iters" % iters: round(dt, 3)},
+                          "train_%d_iters" % iters: round(dt, 3),
+                          **phases},
     }
 
 

@@ -98,7 +98,13 @@ class Dataset:
                 self.feature_name = names
             data = X
 
-        X = _to_2d_float(data)
+        from .io.dataset import _is_sparse
+        if _is_sparse(data):
+            # scipy sparse flows through un-densified: BinnedDataset bins it
+            # column-wise and EFB packs exclusive features (io/bundle.py)
+            X = data
+        else:
+            X = _to_2d_float(data)
         label = _to_1d(self.label)
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
@@ -111,7 +117,8 @@ class Dataset:
             cat = None
         if self.used_indices is not None:
             # subset construction (basic.py subset/used_indices path)
-            X = X[self.used_indices]
+            X = X[self.used_indices] if not hasattr(X, "tocsr") \
+                else X.tocsr()[self.used_indices]
             if label is not None:
                 label = label[self.used_indices]
 

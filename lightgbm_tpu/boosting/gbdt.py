@@ -102,7 +102,14 @@ def _pad_feature_meta(meta: FeatureMeta, fpad: int) -> FeatureMeta:
         penalty=jnp.concatenate([meta.penalty,
                                  jnp.ones((fpad,), jnp.float32)]),
         monotone=jnp.concatenate([meta.monotone,
-                                  jnp.zeros((fpad,), jnp.int32)]))
+                                  jnp.zeros((fpad,), jnp.int32)]),
+        # padding only happens on meshes, where EFB is off -> identity layout
+        col=jnp.concatenate([meta.col,
+                             jnp.arange(meta.col.shape[0],
+                                        meta.col.shape[0] + fpad,
+                                        dtype=jnp.int32)]),
+        offset=jnp.concatenate([meta.offset, jnp.zeros((fpad,), jnp.int32)]),
+        bundled=jnp.concatenate([meta.bundled, jnp.zeros((fpad,), bool)]))
 
 
 def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta:
@@ -135,10 +142,13 @@ def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta
               "features" % (len(mc), ds.num_total_features))
         for j in range(f):
             monotone[j] = mc[ds.used_features[j]]
+    feat_col, feat_offset, feat_bundled = ds.feature_layout()
     return FeatureMeta(
         num_bin=jnp.asarray(num_bin), missing_type=jnp.asarray(missing),
         default_bin=jnp.asarray(default_bin), is_categorical=jnp.asarray(is_cat),
-        penalty=jnp.asarray(penalty), monotone=jnp.asarray(monotone))
+        penalty=jnp.asarray(penalty), monotone=jnp.asarray(monotone),
+        col=jnp.asarray(feat_col), offset=jnp.asarray(feat_offset),
+        bundled=jnp.asarray(feat_bundled))
 
 
 class GBDT:
@@ -207,13 +217,18 @@ class GBDT:
                 xb_np = np.concatenate(
                     [xb_np, np.zeros((xb_np.shape[0], fpad), xb_np.dtype)],
                     axis=1)
+        if self.mesh is not None and ds.has_bundles:
+            raise LightGBMError(
+                "EFB bundles are not yet supported with a device mesh; "
+                "set enable_bundle=false for distributed training")
         self.num_data = xb_np.shape[0]
-        self._feature_pad = xb_np.shape[1] - ds.num_features
+        self._feature_pad = xb_np.shape[1] - ds.num_columns
         self._row_valid = (jnp.asarray(row_valid) if row_valid is not None
                            else None)
         self.feature_meta = _pad_feature_meta(
             _feature_meta_from_dataset(ds, cfg), self._feature_pad)
-        self.num_bins = max(ds.max_num_bin(), 2)
+        self.num_bins = max(ds.max_col_bins(), 2)
+        self.num_feat_bins = max(ds.max_num_bin(), 2)
         self.xb = jnp.asarray(xb_np)
         if self.mesh is not None:
             self.xb = jax.device_put(
@@ -240,11 +255,19 @@ class GBDT:
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=cfg.min_data_per_group),
             row_chunk=16384,
-            hist_impl=("scatter" if jax.default_backend() == "cpu" else "matmul"),
+            # CPU: XLA scatter-add wins; TPU: the Pallas VMEM-accumulator
+            # kernel is the default device path (the GPUTreeLearner analog,
+            # gpu_tree_learner.cpp:951-1045) — one-hot matmul is the fallback
+            hist_impl=(cfg.tpu_hist_impl if cfg.tpu_hist_impl != "auto" else
+                       ("scatter" if jax.default_backend() == "cpu"
+                        else "pallas")),
             voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
                           and self.mesh is not None else 0),
             with_categorical=bool(np.asarray(self.feature_meta.is_categorical)
-                                  .any()))
+                                  .any()),
+            use_partition=(self.mesh is None),
+            with_efb=ds.has_bundles,
+            num_feat_bins=self.num_feat_bins)
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -351,9 +374,13 @@ class GBDT:
         use_input = self._use_input_grads or obj is None
         is_goss = self.boosting_type == "goss"
         if is_goss:
-            top_cnt = max(1, int(n * self.config.top_rate))
-            other_cnt = max(1, int(n * self.config.other_rate))
-            goss_multiply = float(n - top_cnt) / other_cnt
+            # counts from the REAL row count, not the mesh-padding-inflated
+            # one — padded rows carry |g·h| = 0 and sort last, so top-k over
+            # the padded array with real counts is exact (goss.hpp:87-135)
+            n_real = self.num_data_orig
+            top_cnt = max(1, int(n_real * self.config.top_rate))
+            other_cnt = max(1, int(n_real * self.config.other_rate))
+            goss_multiply = float(n_real - top_cnt) / other_cnt
 
         @jax.jit
         def run_iter(scores, sample_mask, feature_mask,
@@ -379,7 +406,7 @@ class GBDT:
                     thr = jax.lax.top_k(gh, top_cnt)[0][-1]
                     is_top = gh >= thr
                     u = jax.random.uniform(goss_key, (n,))
-                    p_rest = other_cnt / max(n - top_cnt, 1)
+                    p_rest = other_cnt / max(n_real - top_cnt, 1)
                     keep_other = (~is_top) & (u < p_rest)
                     return jnp.where(is_top, 1.0,
                                      jnp.where(keep_other, goss_multiply, 0.0))
@@ -617,17 +644,20 @@ class GBDT:
 
     @staticmethod
     @functools.partial(jax.jit, static_argnames=())
-    def _replay_leaves_binned_impl(split_leaf, split_feature, threshold_bin,
-                                   default_left, missing_type, is_cat,
-                                   cat_bitset, num_bin, default_bin, xb):
-        from ..core.grow import _bin_go_left
+    def _replay_leaves_binned_impl(split_leaf, stored_col, bin_offset,
+                                   threshold_bin, default_left, missing_type,
+                                   is_cat, cat_bitset, num_bin, default_bin,
+                                   xb):
+        from ..core.grow import _bin_go_left, decode_bundle_value
         n = xb.shape[0]
         num_nodes = split_leaf.shape[0]
 
         def step(t, leaf_id):
             active = split_leaf[t] >= 0
-            col = jnp.take(xb, split_feature[t], axis=1)
-            go_left = _bin_go_left(col, threshold_bin[t], default_left[t],
+            col = jnp.take(xb, stored_col[t], axis=1)
+            binv = decode_bundle_value(col, bin_offset[t], num_bin[t],
+                                       default_bin[t])
+            go_left = _bin_go_left(binv, threshold_bin[t], default_left[t],
                                    missing_type[t], num_bin[t], default_bin[t],
                                    is_cat[t], cat_bitset[t])
             in_node = leaf_id == split_leaf[t]
@@ -638,7 +668,7 @@ class GBDT:
 
     def _replay_leaves_binned(self, ht: HostTree, xb: jnp.ndarray) -> jnp.ndarray:
         ds = self.train_data
-        nn = ht.num_nodes
+        feat_col, feat_offset, _ = ds.feature_layout()
         inner = np.array([max(ds.inner_feature_index(int(f)), 0)
                           for f in ht.split_feature], np.int32)
         num_bin = np.array([ds.bin_mappers[int(f)].num_bin
@@ -646,7 +676,8 @@ class GBDT:
         default_bin = np.array([ds.bin_mappers[int(f)].default_bin
                                 for f in ht.split_feature], np.int32)
         return self._replay_leaves_binned_impl(
-            jnp.asarray(ht.split_leaf), jnp.asarray(inner),
+            jnp.asarray(ht.split_leaf), jnp.asarray(feat_col[inner]),
+            jnp.asarray(feat_offset[inner]),
             jnp.asarray(ht.threshold_bin), jnp.asarray(ht.default_left),
             jnp.asarray(ht.missing_type), jnp.asarray(ht.is_categorical),
             jnp.asarray(ht.cat_bitset_bin), jnp.asarray(num_bin),

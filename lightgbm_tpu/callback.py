@@ -1,20 +1,28 @@
-"""Training callbacks (reference: python-package/lightgbm/callback.py).
+"""Training callbacks.
 
-Same contract: callbacks receive a ``CallbackEnv`` namedtuple before/after
-each iteration; ``EarlyStopException`` unwinds the training loop
-(callback.py:16-31, 55-153).
+Mirrors the reference callback *contract* (python-package/lightgbm/callback.py):
+factories return callables that receive a ``CallbackEnv`` before/after each
+iteration; an ``order`` attribute sequences them; ``before_iteration`` selects
+the phase; ``EarlyStopException`` unwinds the train loop. The implementations
+here are small stateful classes rather than closure triples — state is explicit
+and picklable, and each callback's behavior is testable in isolation.
 """
 from __future__ import annotations
 
 import collections
-from operator import gt, lt
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 from .log import Log
 
+# Parameters that would change the model topology mid-training; resetting
+# them is rejected (the reference enforces the same set).
+_IMMUTABLE_DURING_TRAIN = frozenset({
+    "num_class", "num_classes", "boosting", "boost", "boosting_type",
+    "metric", "metrics", "metric_types"})
+
 
 class EarlyStopException(Exception):
-    """Signals the train loop to stop (callback.py:16)."""
+    """Thrown by early_stopping to unwind the boosting loop."""
 
     def __init__(self, best_iteration: int, best_score):
         super().__init__()
@@ -28,139 +36,174 @@ CallbackEnv = collections.namedtuple(
      "evaluation_result_list"])
 
 
-def _format_eval_result(value, show_stdv: bool = True) -> str:
-    """callback.py:34-46."""
-    if len(value) == 4:
-        return "%s's %s: %g" % (value[0], value[1], value[2])
-    if len(value) == 5:
-        if show_stdv:
-            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
-        return "%s's %s: %g" % (value[0], value[1], value[2])
-    raise ValueError("Wrong metric value")
+def _eval_text(entry, show_stdv: bool = True) -> str:
+    """Render one evaluation tuple: 4-tuple = plain eval, 5-tuple = cv
+    aggregate with stdv."""
+    data_name, metric_name, value = entry[0], entry[1], entry[2]
+    text = "%s's %s: %g" % (data_name, metric_name, value)
+    if len(entry) == 5 and show_stdv:
+        text += " + %g" % entry[4]
+    elif len(entry) not in (4, 5):
+        raise ValueError("evaluation entry must have 4 or 5 fields, got %d"
+                         % len(entry))
+    return text
+
+
+class _PrintEvaluation:
+    before_iteration = False
+    order = 10
+
+    def __init__(self, period: int, show_stdv: bool):
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        it = env.iteration + 1
+        if it % self.period == 0:
+            Log.info("[%d]\t%s", it, "\t".join(
+                _eval_text(e, self.show_stdv)
+                for e in env.evaluation_result_list))
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    """callback.py:49-72."""
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list \
-                and (env.iteration + 1) % period == 0:
-            result = "\t".join(
-                _format_eval_result(x, show_stdv)
-                for x in env.evaluation_result_list)
-            Log.info("[%d]\t%s", env.iteration + 1, result)
-    _callback.order = 10
-    return _callback
+    """Log evaluation results every ``period`` iterations."""
+    return _PrintEvaluation(period, show_stdv)
+
+
+class _RecordEvaluation:
+    before_iteration = False
+    order = 20
+
+    def __init__(self, store: Dict[str, Dict[str, List[float]]]):
+        self.store = store
+
+    def __call__(self, env: CallbackEnv) -> None:
+        for entry in env.evaluation_result_list:
+            data_name, metric_name, value = entry[0], entry[1], entry[2]
+            per_data = self.store.setdefault(data_name,
+                                             collections.OrderedDict())
+            per_data.setdefault(metric_name, []).append(value)
 
 
 def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
-    """callback.py:75-105."""
+    """Append each iteration's eval values into ``eval_result`` in place."""
     if not isinstance(eval_result, dict):
-        raise TypeError("eval_result should be a dictionary")
+        raise TypeError("eval_result must be a dict, got %s"
+                        % type(eval_result).__name__)
     eval_result.clear()
+    return _RecordEvaluation(eval_result)
 
-    def _init(env: CallbackEnv) -> None:
-        for data_name, eval_name, _, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
 
-    def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for data_name, eval_name, result, _ in env.evaluation_result_list:
-            eval_result[data_name][eval_name].append(result)
-    _callback.order = 20
-    return _callback
+class _ResetParameter:
+    before_iteration = True
+    order = 10
+
+    def __init__(self, schedules: Dict[str, Any]):
+        for key in schedules:
+            if key in _IMMUTABLE_DURING_TRAIN:
+                raise RuntimeError("Cannot reset %r during training" % key)
+        self.schedules = schedules
+
+    def _value_at(self, key: str, value, step: int, total: int):
+        if callable(value):
+            return value(step)
+        if len(value) != total:
+            raise ValueError(
+                "schedule list for %r has %d entries; expected "
+                "num_boost_round = %d" % (key, len(value), total))
+        return value[step]
+
+    def __call__(self, env: CallbackEnv) -> None:
+        step = env.iteration - env.begin_iteration
+        total = env.end_iteration - env.begin_iteration
+        changed = {}
+        for key, value in self.schedules.items():
+            new = self._value_at(key, value, step, total)
+            if env.params.get(key) != new:
+                changed[key] = new
+        if changed:
+            env.model.reset_parameter(changed)
+            env.params.update(changed)
 
 
 def reset_parameter(**kwargs) -> Callable:
-    """callback.py:108-146: per-iteration parameter schedules; values may be
-    lists (indexed by iteration) or callables iteration -> value."""
-    def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if key in ("num_class", "num_classes", "boosting", "boost",
-                       "boosting_type", "metric", "metrics", "metric_types"):
-                raise RuntimeError("Cannot reset %s during training" % repr(key))
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(
-                        "Length of list %r has to equal to 'num_boost_round'."
-                        % key)
-                new_param = value[env.iteration - env.begin_iteration]
-            else:
-                new_param = value(env.iteration - env.begin_iteration)
-            if new_param != env.params.get(key, None):
-                new_parameters[key] = new_param
-        if new_parameters:
-            env.model.reset_parameter(new_parameters)
-            env.params.update(new_parameters)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    """Per-iteration parameter schedules: each kwarg is a list indexed by
+    iteration or a callable ``iteration -> value`` (e.g. learning_rate
+    decay)."""
+    return _ResetParameter(kwargs)
+
+
+class _EarlyStopping:
+    before_iteration = False
+    order = 30
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool,
+                 verbose: bool):
+        self.stopping_rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.enabled: Optional[bool] = None   # decided on first call
+        self.state: List[dict] = []           # one slot per eval entry
+
+    def _start(self, env: CallbackEnv) -> None:
+        self.enabled = all(
+            env.params.get(a) != "dart"
+            for a in ("boosting", "boosting_type", "boost"))
+        if not self.enabled:
+            Log.warning("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError("early stopping needs at least one validation "
+                             "set with an eval metric")
+        if self.verbose:
+            Log.info("Training until validation scores don't improve for %d "
+                     "rounds.", self.stopping_rounds)
+        for entry in env.evaluation_result_list:
+            bigger_better = entry[3]
+            self.state.append({
+                "best": float("-inf") if bigger_better else float("inf"),
+                "better": (lambda a, b: a > b) if bigger_better
+                          else (lambda a, b: a < b),
+                "best_iter": 0,
+                "best_entries": None,
+            })
+
+    def _finish(self, slot: dict, reason: str) -> None:
+        if self.verbose:
+            Log.info("%s Best iteration is:\n[%d]\t%s", reason,
+                     slot["best_iter"] + 1,
+                     "\t".join(_eval_text(e) for e in slot["best_entries"]))
+        raise EarlyStopException(slot["best_iter"], slot["best_entries"])
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.enabled is None:
+            self._start(env)
+        if not self.enabled:
+            return
+        for i, entry in enumerate(env.evaluation_result_list):
+            slot = self.state[i]
+            value = entry[2]
+            if slot["best_entries"] is None or slot["better"](value,
+                                                              slot["best"]):
+                slot.update(best=value, best_iter=env.iteration,
+                            best_entries=env.evaluation_result_list)
+            # the training set never triggers a stop — only validations do
+            is_train = entry[0] in ("training",
+                                    getattr(env.model, "train_set_name",
+                                            "training"))
+            if not is_train:
+                if env.iteration - slot["best_iter"] >= self.stopping_rounds:
+                    self._finish(slot, "Early stopping.")
+                if env.iteration == env.end_iteration - 1:
+                    self._finish(slot, "Did not meet early stopping.")
+            if self.first_metric_only:
+                break
 
 
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
-    """callback.py:149-236."""
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List[Any] = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
-            Log.warning("Early stopping is not available in dart mode")
-            return
-        if not env.evaluation_result_list:
-            raise ValueError(
-                "For early stopping, at least one dataset and eval metric is "
-                "required for evaluation")
-        if verbose:
-            Log.info("Training until validation scores don't improve for %d "
-                     "rounds.", stopping_rounds)
-        for eval_ret in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if eval_ret[3]:  # bigger is better
-                best_score.append(float("-inf"))
-                cmp_op.append(gt)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lt)
-
-    def _callback(env: CallbackEnv) -> None:
-        if not best_score:
-            _init(env)
-        if not enabled[0]:
-            return
-        for i, eval_ret in enumerate(env.evaluation_result_list):
-            score = eval_ret[2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            # train metric doesn't trigger early stop (callback.py:206-209)
-            if eval_ret[0] == "training" or eval_ret[0] == env.model.train_set_name:
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    Log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1, "\t".join(
-                                 _format_eval_result(x)
-                                 for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    Log.info("Did not meet early stopping. Best iteration is:"
-                             "\n[%d]\t%s", best_iter[i] + 1, "\t".join(
-                                 _format_eval_result(x)
-                                 for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if first_metric_only:
-                break
-    _callback.order = 30
-    return _callback
+    """Stop when no validation metric improved for ``stopping_rounds``
+    consecutive iterations; records the best iteration on the exception."""
+    return _EarlyStopping(stopping_rounds, first_metric_only, verbose)

@@ -154,6 +154,10 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("gpu_use_dp", bool, False, []),
     # ---- TPU-specific extensions (no reference counterpart) ----
     ("tpu_hist_dtype", str, "float32", []),   # histogram accumulation dtype
+    # histogram kernel: auto (pallas on TPU, scatter on CPU) | pallas |
+    # matmul | scatter | pallas_interpret — the GPUTreeLearner device-path
+    # dispatch analog (tree_learner.cpp:9-31 device_type axis)
+    ("tpu_hist_impl", str, "auto", []),
     ("tpu_donate_buffers", bool, True, []),   # donate score/state buffers under jit
     ("mesh_shape", list, [], []),             # e.g. [8] / [4,2]; empty = all devices on one axis
 ]

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import build_histogram
+from .partition import RowPartition, hist_for_leaf, init_partition, split_leaf
 from .split import (BestSplit, FeatureMeta, SplitParams, K_MIN_SCORE,
                     MISSING_NAN, MISSING_NONE, MISSING_ZERO,
                     calculate_leaf_output, find_best_split,
@@ -54,6 +55,18 @@ class GrowParams(NamedTuple):
     # dataset has categorical features -> run the categorical split finder
     # alongside the numerical one (FindBestThreshold dispatch)
     with_categorical: bool = False
+    # row-partition mode (DataPartition analog, core/partition.py): keep rows
+    # grouped by leaf and build each histogram only over the leaf's rows —
+    # O(N x depth) row visits per tree instead of O(N x num_leaves). Single
+    # device only; mesh paths keep masked full passes (a gather through a
+    # sharded order array would defeat GSPMD).
+    use_partition: bool = False
+    # EFB (io/bundle.py): histograms are built over stored bundle columns
+    # ([C, num_bins]) and expanded to per-feature views ([F, num_feat_bins])
+    # before split search; split decisions decode column values through
+    # meta.col/offset. num_feat_bins = 0 means "same as num_bins".
+    with_efb: bool = False
+    num_feat_bins: int = 0
 
 
 class TreeArrays(NamedTuple):
@@ -121,6 +134,7 @@ class _GrowState(NamedTuple):
     tree: TreeArrays
     leaf_min: jnp.ndarray     # [L] f32 monotone lower output bound
     leaf_max: jnp.ndarray     # [L] f32 monotone upper output bound
+    part: Optional[RowPartition]  # row partition (use_partition mode only)
 
 
 def _empty_best(num_leaves: int) -> BestSplit:
@@ -141,6 +155,20 @@ def _empty_best(num_leaves: int) -> BestSplit:
 
 def _masked_set(arr: jnp.ndarray, idx: jnp.ndarray, val, valid) -> jnp.ndarray:
     return arr.at[idx].set(jnp.where(valid, val, arr[idx]))
+
+
+def decode_bundle_value(v: jnp.ndarray, offset: jnp.ndarray,
+                        num_bin: jnp.ndarray,
+                        default_bin: jnp.ndarray) -> jnp.ndarray:
+    """Stored bundle-column value -> the feature's own bin index.
+
+    A value inside [offset, offset + num_bin) belongs to this feature;
+    anything else means some bundle-mate (or the shared zero slot) is active,
+    i.e. this feature sits at its default bin (io/bundle.py encoding).
+    Identity for singleton columns (offset 0, values always in range).
+    """
+    vv = v.astype(jnp.int32) - offset
+    return jnp.where((vv >= 0) & (vv < num_bin), vv, default_bin)
 
 
 def _bin_go_left(col: jnp.ndarray, threshold: jnp.ndarray,
@@ -171,12 +199,15 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     assumed sharded over that mesh axis and histograms/root sums are
     psum-reduced (the data-parallel learner's ReduceScatter analog).
     """
-    n, f = xb.shape
+    n, ncols = xb.shape                 # stored columns (== F without EFB)
+    f = meta.num_bin.shape[0]           # logical features
     l = params.num_leaves
-    b = params.num_bins
+    b = params.num_bins                 # column-histogram bin axis
+    bf = params.num_feat_bins or b      # per-feature bin axis (split search)
     sp = params.split
 
     voting = params.voting_top_k > 0 and axis_name is not None
+    use_partition = params.use_partition and axis_name is None
 
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
@@ -188,9 +219,33 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # subtraction); only elected candidates are reduced, in voting_best
         return h if voting else psum(h)
 
+    def expand(hist, sum_g, sum_h, cnt):
+        """[C, B, 3] column histograms -> [F, Bf, 3] per-feature views.
+
+        Each feature's bins are a contiguous slice of its column
+        (feature_group.h bin_offsets_). A bundled feature's default bin is
+        shared with its bundle-mates, so its entry is rebuilt from leaf
+        totals — the Dataset::FixHistogram idea (dataset.h:411-412).
+        """
+        if not params.with_efb:
+            return hist
+        flat = hist.reshape(ncols * b, 3)
+        bidx = jnp.arange(bf, dtype=jnp.int32)[None, :]          # [1, Bf]
+        idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
+        in_feat = bidx < meta.num_bin[:, None]                   # [F, Bf]
+        out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
+            * in_feat[..., None]
+        totals = jnp.stack([sum_g, sum_h, cnt])                  # [3]
+        is_def = bidx == meta.default_bin[:, None]               # [F, Bf]
+        sum_wo_def = jnp.sum(jnp.where(is_def[..., None], 0.0, out), axis=1)
+        rebuilt = totals[None, :] - sum_wo_def                   # [F, 3]
+        return jnp.where((is_def & meta.bundled[:, None])[..., None],
+                         rebuilt[:, None, :], out)
+
     def full_best(hist, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
                   max_c=jnp.inf):
-        bs = find_best_split(hist, meta, sp, sum_g, sum_h, cnt,
+        bs = find_best_split(expand(hist, sum_g, sum_h, cnt), meta, sp,
+                             sum_g, sum_h, cnt,
                              feature_mask, min_constraint=min_c,
                              max_constraint=max_c,
                              with_categorical=params.with_categorical)
@@ -247,7 +302,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     best0 = best_for(hist_root, root_g, root_h, root_c, True)  # root: depth 0
     best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
 
-    hist_pool = jnp.zeros((l, f, b, 3), jnp.float32)
+    hist_pool = jnp.zeros((l, ncols, b, 3), jnp.float32)
     if voting:
         # the pool holds LOCAL histograms in voting mode -> device-varying
         hist_pool = lax.pcast(hist_pool, (axis_name,), to="varying")
@@ -258,10 +313,12 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # under shard_map the carry must be marked device-varying up front:
         # it starts as a constant but becomes a function of the sharded rows
         leaf_id0 = lax.pcast(leaf_id0, (axis_name,), to="varying")
+    part0 = init_partition(n, l, params.row_chunk) if use_partition else None
     state = _GrowState(leaf_id=leaf_id0, hist_pool=hist_pool,
                        best=best, tree=tree,
                        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
-                       leaf_max=jnp.full((l,), jnp.inf, jnp.float32))
+                       leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
+                       part=part0)
 
     def step(t: jnp.ndarray, s: _GrowState) -> _GrowState:
         tree = s.tree
@@ -270,14 +327,46 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         valid = cur.gain > 0.0  # reference breaks on gain <= 0 (:217-219)
 
         # ---- partition rows of `leaf` (DataPartition::Split analog) ------
-        col = jnp.take(xb, cur.feature, axis=1)
-        go_left = _bin_go_left(
-            col, cur.threshold, cur.default_left,
-            meta.missing_type[cur.feature], meta.num_bin[cur.feature],
-            meta.default_bin[cur.feature], cur.is_categorical, cur.cat_bitset)
-        in_leaf = s.leaf_id == leaf
         right_leaf = t + 1
-        leaf_id = jnp.where(valid & in_leaf & ~go_left, right_leaf, s.leaf_id)
+        if params.with_efb:
+            stored_col = meta.col[cur.feature]
+
+            def to_feat_bin(v):
+                return decode_bundle_value(v, meta.offset[cur.feature],
+                                           meta.num_bin[cur.feature],
+                                           meta.default_bin[cur.feature])
+        else:
+            stored_col = cur.feature
+
+            def to_feat_bin(v):
+                return v
+
+        if use_partition:
+            xb_flat = xb.reshape(-1)
+
+            def go_left_rows(idx):
+                colv = jnp.take(xb_flat, idx * ncols + stored_col,
+                                mode="clip")
+                return _bin_go_left(
+                    to_feat_bin(colv), cur.threshold, cur.default_left,
+                    meta.missing_type[cur.feature],
+                    meta.num_bin[cur.feature],
+                    meta.default_bin[cur.feature],
+                    cur.is_categorical, cur.cat_bitset)
+
+            part, leaf_id = split_leaf(s.part, s.leaf_id, leaf, right_leaf,
+                                       go_left_rows, valid, params.row_chunk)
+        else:
+            part = s.part
+            col = jnp.take(xb, stored_col, axis=1)
+            go_left = _bin_go_left(
+                to_feat_bin(col), cur.threshold, cur.default_left,
+                meta.missing_type[cur.feature], meta.num_bin[cur.feature],
+                meta.default_bin[cur.feature], cur.is_categorical,
+                cur.cat_bitset)
+            in_leaf = s.leaf_id == leaf
+            leaf_id = jnp.where(valid & in_leaf & ~go_left, right_leaf,
+                                s.leaf_id)
 
         # ---- tree bookkeeping (Tree::Split, tree.cpp:49-67) --------------
         node = t
@@ -338,14 +427,21 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         small_leaf = jnp.where(left_smaller, leaf, right_leaf)
         large_leaf = jnp.where(left_smaller, right_leaf, leaf)
 
-        def live_hist(_):
-            m = (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
-            return hist_for_mask(m)
+        if use_partition:
+            # O(rows_in_leaf): gather only the smaller child's rows through
+            # the partition (dead iterations have count 0 -> zero trips)
+            hist_small = hist_for_leaf(part, small_leaf, xb, grad, hess,
+                                       sample_mask, b, params.row_chunk,
+                                       valid=valid, impl=params.hist_impl)
+        elif axis_name is None:
+            def live_hist(_):
+                m = (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
+                return hist_for_mask(m)
 
-        if axis_name is None:
             # skip dead iterations entirely (tree stopped growing early)
             hist_small = lax.cond(valid, live_hist,
-                                  lambda _: jnp.zeros((f, b, 3), jnp.float32),
+                                  lambda _: jnp.zeros((ncols, b, 3),
+                                                      jnp.float32),
                                   operand=None)
         else:
             # collectives can't sit under a cond branch in SPMD code; a dead
@@ -405,7 +501,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         return _GrowState(leaf_id=leaf_id, hist_pool=hist_pool,
                           best=best, tree=tree,
-                          leaf_min=leaf_min, leaf_max=leaf_max)
+                          leaf_min=leaf_min, leaf_max=leaf_max, part=part)
 
     state = lax.fori_loop(0, l - 1, step, state)
     return state.tree, state.leaf_id

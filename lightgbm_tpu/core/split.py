@@ -45,6 +45,11 @@ class FeatureMeta(NamedTuple):
     is_categorical: jnp.ndarray  # [F] bool
     penalty: jnp.ndarray        # [F] f32 feature_contri multiplier
     monotone: jnp.ndarray       # [F] int32 (-1/0/+1, config.h monotone_constraints)
+    # EFB storage layout (feature_group.h:35-50): which stored column the
+    # feature lives in and at which bin offset; None = identity (no bundles)
+    col: Optional[jnp.ndarray] = None       # [F] int32
+    offset: Optional[jnp.ndarray] = None    # [F] int32
+    bundled: Optional[jnp.ndarray] = None   # [F] bool
 
 
 class SplitParams(NamedTuple):

@@ -81,23 +81,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
             booster.add_valid(vs, name_valid_sets[i])
     booster.train_set_name = train_data_name
 
-    cbs = set(callbacks or [])
+    # a list, not a set: equal-`order` callbacks must run in a deterministic
+    # (registration) order — Python's stable sort preserves list order
+    cbs = list(callbacks or [])
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback.early_stopping(
+        cbs.append(callback.early_stopping(
             early_stopping_rounds,
             first_metric_only=bool(params.get("first_metric_only", False))))
     if verbose_eval is True:
-        cbs.add(callback.print_evaluation())
+        cbs.append(callback.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval:
-        cbs.add(callback.print_evaluation(verbose_eval))
+        cbs.append(callback.print_evaluation(verbose_eval))
     if evals_result is not None:
-        cbs.add(callback.record_evaluation(evals_result))
+        cbs.append(callback.record_evaluation(evals_result))
     if learning_rates is not None:
-        cbs.add(callback.reset_parameter(learning_rate=learning_rates))
-    cbs_before = {c for c in cbs if getattr(c, "before_iteration", False)}
-    cbs_after = cbs - cbs_before
-    cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
-    cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
+        cbs.append(callback.reset_parameter(learning_rate=learning_rates))
+    cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs_after = [c for c in cbs if not getattr(c, "before_iteration", False)]
+    cbs_before.sort(key=lambda c: getattr(c, "order", 0))
+    cbs_after.sort(key=lambda c: getattr(c, "order", 0))
 
     # boosting loop (engine.py:211-246)
     init_iteration = booster.current_iteration
@@ -283,13 +285,15 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         cvbooster.append(bst)
 
     results = collections.defaultdict(list)
-    cbs = set(callbacks or [])
+    # list, not set: deterministic order among equal-`order` callbacks
+    cbs = list(callbacks or [])
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback.early_stopping(early_stopping_rounds, verbose=False))
+        cbs.append(callback.early_stopping(early_stopping_rounds,
+                                           verbose=False))
     if verbose_eval is True:
-        cbs.add(callback.print_evaluation(show_stdv=show_stdv))
+        cbs.append(callback.print_evaluation(show_stdv=show_stdv))
     elif isinstance(verbose_eval, int) and verbose_eval:
-        cbs.add(callback.print_evaluation(verbose_eval, show_stdv))
+        cbs.append(callback.print_evaluation(verbose_eval, show_stdv))
     cbs_before = sorted((c for c in cbs if getattr(c, "before_iteration", False)),
                         key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted((c for c in cbs if not getattr(c, "before_iteration", False)),

@@ -4,13 +4,17 @@ TPU-native re-design of the reference Dataset/Metadata/DatasetLoader
 (include/LightGBM/dataset.h:36-627, src/io/dataset.cpp, src/io/metadata.cpp,
 src/io/dataset_loader.cpp). Differences by design:
 
-- Storage is a single dense ``[num_data, num_features] uint8`` bin matrix —
+- Storage is a single dense ``[num_data, num_columns] uint8`` bin matrix —
   the TPU histogram kernels want one contiguous HBM operand, not per-group
-  Bin objects (dense_bin.hpp / sparse_bin.hpp). Sparse inputs are densified
-  at bin time; ``max_bin <= 256`` keeps it one byte per value.
-- EFB-style trivial-feature dropping happens here (used_feature mapping like
-  dataset.h:613-618); full exclusive-feature bundling operates on the binned
-  matrix as a host-side column merge.
+  Bin objects (dense_bin.hpp / sparse_bin.hpp). ``max_bin <= 256`` keeps it
+  one byte per value.
+- Sparse inputs (scipy CSR/CSC) are binned column-by-column without ever
+  materializing the dense float matrix, and EFB (io/bundle.py, the
+  dataset.cpp:67-177 analog) packs mutually-exclusive sparse features into
+  shared columns — so a 95%-sparse input stores ~#bundles columns, not F.
+- Trivial-feature dropping keeps the used_feature mapping (dataset.h:613-618);
+  ``col_features``/``col_offsets`` record the bundle layout
+  (feature_group.h:35-50 bin_offsets_ analog).
 - The "bin once, train many" artifact (dataset_loader.cpp:266 LoadFromBinFile)
   is an ``.npz`` cache of the bin matrix + mappers + metadata.
 """
@@ -25,6 +29,11 @@ import numpy as np
 from ..config import Config
 from ..log import Log, LightGBMError, check
 from .binning import BinMapper, BinType, MissingType
+from .bundle import bundle_offsets, find_bundles
+
+
+def _is_sparse(data) -> bool:
+    return hasattr(data, "tocsc") and hasattr(data, "nnz")
 
 
 class Metadata:
@@ -103,8 +112,14 @@ class BinnedDataset:
         self.num_data: int = 0
         self.num_total_features: int = 0
         self.bin_mappers: List[BinMapper] = []          # per original feature
-        self.used_features: List[int] = []              # original idx of stored cols
-        self.X_binned: Optional[np.ndarray] = None      # [num_data, num_used] uint8
+        self.used_features: List[int] = []              # original idx of used feats
+        self.X_binned: Optional[np.ndarray] = None      # [num_data, num_cols] uint8
+        # EFB layout (feature_group.h:35-50): stored column -> member original
+        # features + their bin offsets; singletons have offsets == [0] (raw
+        # encoding). With no bundling these mirror used_features 1:1.
+        self.col_features: List[List[int]] = []
+        self.col_offsets: List[List[int]] = []
+        self.col_num_bin: List[int] = []
         self.metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin: int = 255
@@ -120,17 +135,37 @@ class BinnedDataset:
                     feature_names: Optional[List[str]] = None,
                     categorical_feature: Optional[Union[str, List]] = None,
                     reference: Optional["BinnedDataset"] = None) -> "BinnedDataset":
-        """Bin a raw [N, F] float matrix (DatasetLoader::CostructFromSampleData
-        analog, dataset_loader.cpp:700-820)."""
-        data = np.asarray(data)
-        if data.ndim != 2:
-            raise LightGBMError("Data should be 2-D, got shape %s" % (data.shape,))
-        n, f = data.shape
+        """Bin a raw [N, F] matrix — dense ndarray or scipy sparse CSR/CSC
+        (DatasetLoader::CostructFromSampleData analog, dataset_loader.cpp:
+        700-820; sparse path never densifies the float matrix)."""
+        sparse = _is_sparse(data)
+        if sparse:
+            csc = data.tocsc()
+            csc.sum_duplicates()
+            n, f = csc.shape
+            data64 = None
+        else:
+            csc = None
+            data = np.asarray(data)
+            if data.ndim != 2:
+                raise LightGBMError("Data should be 2-D, got shape %s"
+                                    % (data.shape,))
+            n, f = data.shape
+            data64 = np.asarray(data, dtype=np.float64)
         self = cls()
         self.num_data = n
         self.num_total_features = f
         self.max_bin = config.max_bin
         self.feature_names = feature_names or ["Column_%d" % i for i in range(f)]
+
+        def column_nonzeros(j):
+            """(rows, float64 values) of column j's stored/non-zero entries."""
+            if sparse:
+                sl = slice(csc.indptr[j], csc.indptr[j + 1])
+                return csc.indices[sl], np.asarray(csc.data[sl], np.float64)
+            col = data64[:, j]
+            rows = np.flatnonzero(~((col >= -1e-35) & (col <= 1e-35)))
+            return rows, col[rows]
 
         if reference is not None:
             # validation set: reuse the reference's bin mappers / layout
@@ -140,29 +175,45 @@ class BinnedDataset:
             self.bin_mappers = reference.bin_mappers
             self.used_features = reference.used_features
             self.feature_names = reference.feature_names
+            self.col_features = reference.col_features
+            self.col_offsets = reference.col_offsets
+            self.col_num_bin = reference.col_num_bin
         else:
             cat_idx = set(_parse_categorical(
                 categorical_feature if categorical_feature is not None
                 else config.categorical_feature, self.feature_names))
-            self.bin_mappers = []
             sample_cnt = min(n, config.bin_construct_sample_cnt)
             if sample_cnt < n:
                 rng = np.random.RandomState(config.data_random_seed)
-                sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+                sample_rows = np.sort(rng.choice(n, sample_cnt, replace=False))
+                # row id -> sample position (-1 = not sampled)
+                sample_pos = np.full(n, -1, np.int64)
+                sample_pos[sample_rows] = np.arange(sample_cnt)
             else:
-                sample_idx = slice(None)
-            data64 = np.asarray(data, dtype=np.float64)
+                sample_rows = None
+                sample_pos = None
+
+            self.bin_mappers = []
+            nz_sample: List[np.ndarray] = []   # per feature, sample positions
             for j in range(f):
-                col = data64[:, j][sample_idx]
+                rows, vals = column_nonzeros(j)
+                if sample_pos is not None:
+                    pos = sample_pos[rows]
+                    keep = pos >= 0
+                    rows_s, vals_s = pos[keep], vals[keep]
+                else:
+                    rows_s, vals_s = rows, vals
+                nz_sample.append(rows_s.astype(np.int64))
                 mapper = BinMapper()
-                # the reference sampler stores only non-zero values; replicate
-                # (NaNs fail both comparisons and are kept)
-                nz = col[~((col >= -1e-35) & (col <= 1e-35))]
+                # only non-zero values feed FindBin, like the reference's
+                # sampler (NaNs fail both comparisons and are kept)
                 mapper.find_bin(
-                    nz, total_sample_cnt=len(col), max_bin=config.max_bin,
+                    vals_s, total_sample_cnt=sample_cnt,
+                    max_bin=config.max_bin,
                     min_data_in_bin=config.min_data_in_bin,
                     min_split_data=config.min_data_in_leaf,
-                    bin_type=BinType.CATEGORICAL if j in cat_idx else BinType.NUMERICAL,
+                    bin_type=(BinType.CATEGORICAL if j in cat_idx
+                              else BinType.NUMERICAL),
                     use_missing=config.use_missing,
                     zero_as_missing=config.zero_as_missing)
                 self.bin_mappers.append(mapper)
@@ -172,10 +223,55 @@ class BinnedDataset:
                 Log.warning("There are no meaningful features, as all feature "
                             "values are constant.")
 
+            # ---- EFB grouping (dataset.cpp:67-177 analog) ----------------
+            if config.enable_bundle and len(self.used_features) > 1:
+                bundles = find_bundles(
+                    [nz_sample[j] for j in self.used_features], sample_cnt,
+                    [self.bin_mappers[j].num_bin for j in self.used_features],
+                    config.max_conflict_rate,
+                    sparse_threshold=config.sparse_threshold)
+                # bundle entries index into used_features; map back
+                bundles = [[self.used_features[i] for i in b] for b in bundles]
+            else:
+                bundles = [[j] for j in self.used_features]
+            self.col_features = bundles
+            self.col_offsets = []
+            self.col_num_bin = []
+            num_bin_of = {j: self.bin_mappers[j].num_bin
+                          for j in self.used_features}
+            for b in bundles:
+                offs, total = bundle_offsets(b, num_bin_of)
+                self.col_offsets.append(offs)
+                self.col_num_bin.append(total)
+            n_bundled = sum(1 for b in bundles if len(b) > 1)
+            if n_bundled:
+                Log.info("EFB: %d features bundled into %d columns "
+                         "(%d multi-feature bundles)",
+                         len(self.used_features), len(bundles), n_bundled)
+
+        # ---- build the stored uint8 columns ------------------------------
         cols = []
-        data64 = np.asarray(data, dtype=np.float64)
-        for j in self.used_features:
-            cols.append(self.bin_mappers[j].values_to_bins(data64[:, j]).astype(np.uint8))
+        for feats, offs in zip(self.col_features, self.col_offsets):
+            if len(feats) == 1 and offs[0] == 0:
+                j = feats[0]
+                m = self.bin_mappers[j]
+                if sparse:
+                    zero_bin = int(m.values_to_bins(np.zeros(1))[0])
+                    colb = np.full(n, zero_bin, np.uint8)
+                    rows, vals = column_nonzeros(j)
+                    if len(rows):
+                        colb[rows] = m.values_to_bins(vals).astype(np.uint8)
+                else:
+                    colb = m.values_to_bins(data64[:, j]).astype(np.uint8)
+            else:
+                colb = np.zeros(n, np.uint8)
+                for off, j in zip(offs, feats):
+                    m = self.bin_mappers[j]
+                    rows, vals = column_nonzeros(j)
+                    bins = m.values_to_bins(vals)
+                    sel = bins != m.default_bin
+                    colb[rows[sel]] = (off + bins[sel]).astype(np.uint8)
+            cols.append(colb)
         self.X_binned = (np.stack(cols, axis=1) if cols
                          else np.zeros((n, 0), dtype=np.uint8))
 
@@ -184,6 +280,103 @@ class BinnedDataset:
             self.metadata.set_label(label)
         self.metadata.set_weight(weight)
         self.metadata.set_query(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    # ------------------------------------------------------------ sharded
+    @classmethod
+    def from_sharded(cls, local_data, config: Config, comm,
+                     label: Optional[Sequence[float]] = None,
+                     weight: Optional[Sequence[float]] = None,
+                     init_score: Optional[Sequence[float]] = None,
+                     feature_names: Optional[List[str]] = None,
+                     categorical_feature: Optional[Union[str, List]] = None
+                     ) -> "BinnedDataset":
+        """Distributed ingest: every host binds only its own row shard.
+
+        The reference's distributed loading (dataset_loader.cpp:469-495 row
+        partition, :548-640 feature-sharded bin finding + Allgather of
+        BinMappers) re-designed for exact parity: each host samples its local
+        rows, the per-feature samples are allgathered (bounded by
+        bin_construct_sample_cnt), and every host runs FindBin on the merged
+        sample — so bin boundaries are identical on all hosts (and identical
+        to a single-host run over the union sample), without any host ever
+        holding the full matrix.
+
+        ``comm`` implements ``allgather(obj) -> list`` over hosts (see
+        lightgbm_tpu.parallel.network; tests use a loopback). The returned
+        dataset covers only the local rows; training on a 'data'-axis mesh
+        then shards naturally.
+        """
+        local_data = np.asarray(local_data)
+        check(local_data.ndim == 2, "local shard must be 2-D")
+        n_local, f = local_data.shape
+        sizes = comm.allgather(n_local)
+        total_n = int(sum(sizes))
+
+        # per-host row sample, proportional share of the global sample budget
+        budget = max(1, int(config.bin_construct_sample_cnt
+                            * (n_local / max(total_n, 1))))
+        sample_cnt = min(n_local, budget)
+        if sample_cnt < n_local:
+            rng = np.random.RandomState(config.data_random_seed + 1
+                                        + len(sizes))
+            rows = np.sort(rng.choice(n_local, sample_cnt, replace=False))
+            sample = np.asarray(local_data[rows], np.float64)
+        else:
+            sample = np.asarray(local_data, np.float64)
+
+        # merge per-feature non-zero sampled values across hosts (the
+        # Allgather at dataset_loader.cpp:615-640, but of raw sample values
+        # so FindBin sees the union sample -> identical mappers everywhere)
+        local_nz = []
+        for j in range(f):
+            col = sample[:, j]
+            local_nz.append(col[~((col >= -1e-35) & (col <= 1e-35))])
+        gathered = comm.allgather((len(sample), local_nz))
+        merged_cnt = int(sum(c for c, _ in gathered))
+        merged = [np.concatenate([g[1][j] for g in gathered])
+                  for j in range(f)]
+
+        names = feature_names or ["Column_%d" % i for i in range(f)]
+        cat_idx = set(_parse_categorical(
+            categorical_feature if categorical_feature is not None
+            else config.categorical_feature, names))
+        mappers: List[BinMapper] = []
+        for j in range(f):
+            m = BinMapper()
+            m.find_bin(merged[j], total_sample_cnt=merged_cnt,
+                       max_bin=config.max_bin,
+                       min_data_in_bin=config.min_data_in_bin,
+                       min_split_data=config.min_data_in_leaf,
+                       bin_type=(BinType.CATEGORICAL if j in cat_idx
+                                 else BinType.NUMERICAL),
+                       use_missing=config.use_missing,
+                       zero_as_missing=config.zero_as_missing)
+            mappers.append(m)
+
+        self = cls()
+        self.num_data = n_local
+        self.num_total_features = f
+        self.max_bin = config.max_bin
+        self.feature_names = names
+        self.bin_mappers = mappers
+        self.used_features = [j for j in range(f) if not mappers[j].is_trivial]
+        # bundling needs a global conflict view; keep the identity layout in
+        # sharded mode (EFB is a single-host/mesh-local optimization for now)
+        self.col_features = [[j] for j in self.used_features]
+        self.col_offsets = [[0] for _ in self.used_features]
+        self.col_num_bin = [mappers[j].num_bin for j in self.used_features]
+
+        data64 = np.asarray(local_data, np.float64)
+        cols = [mappers[j].values_to_bins(data64[:, j]).astype(np.uint8)
+                for j in self.used_features]
+        self.X_binned = (np.stack(cols, axis=1) if cols
+                         else np.zeros((n_local, 0), np.uint8))
+        self.metadata = Metadata(n_local)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
         self.metadata.set_init_score(init_score)
         return self
 
@@ -210,6 +403,38 @@ class BinnedDataset:
         return max((self.feature_num_bin(i) for i in range(self.num_features)),
                    default=1)
 
+    # ------------------------------------------------------------ EFB layout
+    @property
+    def num_columns(self) -> int:
+        """Stored bin-matrix columns (== num_features when nothing bundled)."""
+        return len(self.col_features)
+
+    def max_col_bins(self) -> int:
+        """Largest encoded bin count of any stored column (histogram B)."""
+        return max(self.col_num_bin, default=1)
+
+    @property
+    def has_bundles(self) -> bool:
+        return any(len(b) > 1 for b in self.col_features)
+
+    def feature_layout(self):
+        """Per used-feature (inner index) storage arrays:
+        (feat_col, feat_offset, feat_bundled) int32/int32/bool — where each
+        feature lives in the stored matrix and at which bin offset."""
+        fcount = self.num_features
+        feat_col = np.zeros(fcount, np.int32)
+        feat_offset = np.zeros(fcount, np.int32)
+        feat_bundled = np.zeros(fcount, bool)
+        inner = {j: i for i, j in enumerate(self.used_features)}
+        for ci, (feats, offs) in enumerate(zip(self.col_features,
+                                               self.col_offsets)):
+            for off, j in zip(offs, feats):
+                i = inner[j]
+                feat_col[i] = ci
+                feat_offset[i] = off
+                feat_bundled[i] = len(feats) > 1
+        return feat_col, feat_offset, feat_bundled
+
     def get_feature_infos(self) -> List[str]:
         """Model-file ``feature_infos`` strings ([min:max] / categorical list)."""
         infos = []
@@ -233,6 +458,9 @@ class BinnedDataset:
             "feature_names": self.feature_names,
             "max_bin": self.max_bin,
             "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+            "col_features": self.col_features,
+            "col_offsets": self.col_offsets,
+            "col_num_bin": self.col_num_bin,
         }
         arrays: Dict[str, np.ndarray] = {"X_binned": self.X_binned}
         if self.metadata.label is not None:
@@ -257,6 +485,14 @@ class BinnedDataset:
             self.feature_names = list(meta["feature_names"])
             self.max_bin = meta["max_bin"]
             self.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
+            self.col_features = [list(b) for b in meta.get(
+                "col_features", [[j] for j in self.used_features])]
+            self.col_offsets = [list(o) for o in meta.get(
+                "col_offsets", [[0]] * len(self.col_features))]
+            self.col_num_bin = list(meta.get("col_num_bin", []))
+            if not self.col_num_bin:
+                self.col_num_bin = [self.bin_mappers[b[0]].num_bin
+                                    for b in self.col_features]
             self.X_binned = z["X_binned"]
             self.metadata = Metadata(self.num_data)
             if "label" in z:

@@ -65,3 +65,67 @@ def num_machines() -> int:
 
 def rank() -> int:
     return _rank
+
+
+class HostComm:
+    """Host-side allgather seam for distributed ingest (the pluggable
+    collectives idea of LGBM_NetworkInitWithFunctions, network.h:96 /
+    c_api.h:958 — kept so tests can run the identical code path without a
+    cluster).
+
+    ``allgather(obj) -> list[obj]`` returns every host's object in rank
+    order. The jax implementation rides jax.experimental.multihost_utils;
+    LoopbackComm simulates K hosts in one process for tests.
+    """
+
+    def allgather(self, obj):
+        raise NotImplementedError
+
+
+class JaxHostComm(HostComm):
+    """Cross-host allgather via jax.distributed (host metadata only — the
+    heavy per-iteration collectives are XLA ops inside the training step).
+
+    Arbitrary picklable objects (ragged arrays included) are supported by
+    gathering pickled bytes: lengths first (fixed shape), then the padded
+    byte arrays — the same serialize-then-Allgather shape as the reference's
+    BinMapper sync (dataset_loader.cpp:615-640)."""
+
+    def allgather(self, obj):
+        import pickle
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        blob = _np.frombuffer(pickle.dumps(obj), dtype=_np.uint8)
+        lengths = multihost_utils.process_allgather(
+            _np.asarray([blob.size], _np.int64))
+        lengths = _np.asarray(lengths).reshape(-1)
+        maxlen = int(lengths.max())
+        padded = _np.zeros(maxlen, _np.uint8)
+        padded[:blob.size] = blob
+        stacked = _np.asarray(multihost_utils.process_allgather(padded))
+        stacked = stacked.reshape(len(lengths), maxlen)
+        return [pickle.loads(stacked[i, :int(lengths[i])].tobytes())
+                for i in range(len(lengths))]
+
+
+class LoopbackComm(HostComm):
+    """Test double: K simulated hosts as K threads in one process, with a
+    barrier-synchronized allgather — the collective semantics are real
+    (rank-ordered, lockstep) without any cluster."""
+
+    def __init__(self, shared: dict, my_rank: int):
+        self._shared = shared
+        self._rank = my_rank
+
+    @staticmethod
+    def group(k: int) -> List["LoopbackComm"]:
+        import threading
+        shared = {"slots": [None] * k, "barrier": threading.Barrier(k)}
+        return [LoopbackComm(shared, r) for r in range(k)]
+
+    def allgather(self, obj):
+        self._shared["slots"][self._rank] = obj
+        self._shared["barrier"].wait()
+        out = list(self._shared["slots"])
+        self._shared["barrier"].wait()   # don't overwrite until all read
+        return out

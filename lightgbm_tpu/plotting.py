@@ -1,23 +1,67 @@
-"""Plotting utilities.
+"""Plotting utilities: feature importance, metric history, tree rendering.
 
-Reference: python-package/lightgbm/plotting.py — plot_importance (:30),
-plot_metric (:144), plot_tree / create_tree_digraph (:318). matplotlib and
-graphviz are optional; informative errors otherwise (compat.py pattern).
+Covers the same public surface as the reference's plotting module
+(plot_importance / plot_metric / plot_tree / create_tree_digraph), built on
+three shared helpers (_resolve_booster, _new_axes, _style_axes) so each
+plot function is mostly declarative. matplotlib and graphviz are optional
+imports with informative errors.
 """
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .basic import Booster
-from .log import LightGBMError
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError("%s must be a tuple of 2 elements." % obj_name)
+def _resolve_booster(obj) -> Booster:
+    """Accept a Booster or a fitted sklearn wrapper (``.booster_``)."""
+    if isinstance(obj, Booster):
+        return obj
+    inner = getattr(obj, "booster_", None)
+    if isinstance(inner, Booster):
+        return inner
+    raise TypeError("expected a Booster or fitted LGBMModel, got %s"
+                    % type(obj).__name__)
+
+
+def _pair(value, name: str):
+    """Validate an (a, b) tuple argument (xlim/ylim/figsize)."""
+    if not (isinstance(value, tuple) and len(value) == 2):
+        raise TypeError("%s must be a tuple of 2 elements." % name)
+    return value
+
+
+def _new_axes(ax, figsize):
+    if ax is not None:
+        return ax
+    import matplotlib.pyplot as plt
+    if figsize is not None:
+        _pair(figsize, "figsize")
+    return plt.subplots(1, 1, figsize=figsize)[1]
+
+
+def _style_axes(ax, *, title, xlabel, ylabel, xlim=None, ylim=None,
+                grid=True):
+    if xlim is not None:
+        ax.set_xlim(_pair(xlim, "xlim"))
+    if ylim is not None:
+        ax.set_ylim(_pair(ylim, "ylim"))
+    for setter, value in ((ax.set_title, title), (ax.set_xlabel, xlabel),
+                          (ax.set_ylabel, ylabel)):
+        if value is not None:
+            setter(value)
+    ax.grid(grid)
+    return ax
+
+
+def _require_matplotlib(what: str):
+    try:
+        import matplotlib  # noqa: F401
+    except ImportError as e:
+        raise ImportError("matplotlib is required to %s" % what) from e
 
 
 def plot_importance(booster, ax=None, height: float = 0.2,
@@ -26,56 +70,32 @@ def plot_importance(booster, ax=None, height: float = 0.2,
                     importance_type: str = "split", max_num_features=None,
                     ignore_zero: bool = True, figsize=None, grid: bool = True,
                     precision: Optional[int] = 3, **kwargs):
-    """plotting.py:30."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot importance")
+    """Horizontal bar chart of per-feature importance."""
+    _require_matplotlib("plot importance")
+    bst = _resolve_booster(booster)
+    values = np.asarray(bst.feature_importance(importance_type), np.float64)
+    names = list(bst.feature_name())
+    if values.size == 0:
+        raise ValueError("the model has no feature importances to plot")
 
-    if isinstance(booster, Booster):
-        importance = booster.feature_importance(importance_type)
-        feature_name = booster.feature_name()
-    elif hasattr(booster, "booster_"):
-        importance = booster.booster_.feature_importance(importance_type)
-        feature_name = booster.booster_.feature_name()
-    else:
-        raise TypeError("booster must be Booster or LGBMModel")
-
-    if not len(importance):
-        raise ValueError("Booster's feature_importance is empty")
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    order = np.argsort(values, kind="stable")
     if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+        order = order[values[order] > 0]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples)
+        order = order[-max_num_features:]
+    shown = values[order]
+    ypos = np.arange(order.size)
 
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y,
-                ("%." + str(precision) + "f") % x if precision is not None
-                and importance_type == "gain" else str(int(x)), va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    ax = _new_axes(ax, figsize)
+    ax.barh(ypos, shown, align="center", height=height, **kwargs)
+    fmt = ("%%.%df" % precision) if (precision is not None
+                                     and importance_type == "gain") else None
+    for y, v in zip(ypos, shown):
+        ax.text(v + 1, y, fmt % v if fmt else str(int(v)), va="center")
+    ax.set_yticks(ypos)
+    ax.set_yticklabels([names[i] for i in order])
+    return _style_axes(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                       xlim=xlim, ylim=ylim, grid=grid)
 
 
 def plot_metric(booster, metric: Optional[str] = None,
@@ -83,144 +103,110 @@ def plot_metric(booster, metric: Optional[str] = None,
                 xlim=None, ylim=None, title="Metric during training",
                 xlabel="Iterations", ylabel="auto", figsize=None,
                 grid: bool = True):
-    """plotting.py:144: plot recorded eval history (record_evaluation dict or
-    a fitted LGBMModel)."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot metric")
+    """Line chart of a recorded eval metric across iterations.
 
+    ``booster`` is either the dict filled by ``record_evaluation`` or a
+    fitted sklearn wrapper carrying ``evals_result_``.
+    """
+    _require_matplotlib("plot metrics")
     if isinstance(booster, dict):
-        eval_results = deepcopy(booster)
+        history = deepcopy(booster)
     elif hasattr(booster, "evals_result_"):
-        eval_results = deepcopy(booster.evals_result_)
+        history = deepcopy(booster.evals_result_)
     else:
-        raise TypeError("booster must be dict or LGBMModel")
-    if not eval_results:
-        raise ValueError("eval results cannot be empty")
+        raise TypeError("expected a record_evaluation dict or fitted "
+                        "LGBMModel, got %s" % type(booster).__name__)
+    if not history:
+        raise ValueError("no recorded evaluation results to plot")
 
-    if dataset_names is None:
-        dataset_names = list(eval_results.keys())
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize)
-
-    name = dataset_names[0]
-    metrics_for_one = eval_results[name]
+    names = dataset_names if dataset_names is not None else list(history)
+    first = history[names[0]]
     if metric is None:
-        if len(metrics_for_one) > 1:
-            raise ValueError("more than one metric available, pick one")
-        metric, results = list(metrics_for_one.items())[0]
-    else:
-        if metric not in metrics_for_one:
-            raise ValueError("specific metric not found")
-        results = metrics_for_one[metric]
-    num_iteration = len(results)
-    max_result, min_result = max(results), min(results)
-    for name in dataset_names:
-        results = eval_results[name][metric]
-        max_result = max(max(results), max_result)
-        min_result = min(min(results), min_result)
-        ax.plot(range(num_iteration), results, label=name)
+        if len(first) != 1:
+            raise ValueError("several metrics were recorded; pass `metric` "
+                             "to pick one of %s" % sorted(first))
+        metric = next(iter(first))
+    elif metric not in first:
+        raise ValueError("metric %r was not recorded for dataset %r"
+                         % (metric, names[0]))
+
+    ax = _new_axes(ax, figsize)
+    lo, hi = float("inf"), float("-inf")
+    for name in names:
+        series = history[name][metric]
+        lo, hi = min(lo, min(series)), max(hi, max(series))
+        ax.plot(range(len(series)), series, label=name)
     ax.legend(loc="best")
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
-    else:
-        range_result = max_result - min_result
-        ax.set_ylim(min_result - range_result * 0.2,
-                    max_result + range_result * 0.2)
-    if ylabel == "auto":
-        ylabel = metric
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    if ylim is None:
+        margin = (hi - lo) * 0.2
+        ylim = (lo - margin, hi + margin)
+    return _style_axes(ax, title=title, xlabel=xlabel,
+                       ylabel=metric if ylabel == "auto" else ylabel,
+                       xlim=xlim, ylim=ylim, grid=grid)
 
 
-def _to_graphviz(tree_info: Dict, show_info: List[str],
-                 feature_names: List[str], precision=3, **kwargs):
-    """plotting.py:244 _to_graphviz."""
-    try:
-        from graphviz import Digraph
-    except ImportError:
-        raise ImportError("You must install graphviz to plot tree")
+def _node_label(node: Dict[str, Any], feature_names, show_info, precision):
+    """Build the graphviz label for one dumped-model node."""
+    def rnd(x):
+        return round(x, precision) if isinstance(x, float) else x
 
-    def add(root, parent=None, decision=None):
-        if "split_index" in root:
-            name = "split%d" % root["split_index"]
-            f = root["split_feature"]
-            label = feature_names[f] if feature_names else "feature %d" % f
-            label += " %s %s" % (root.get("decision_type", "<="),
-                                 round(root["threshold"], precision)
-                                 if isinstance(root["threshold"], float)
-                                 else root["threshold"])
-            for info in show_info:
-                if info in ("split_gain", "internal_value"):
-                    label += "\n%s: %s" % (info, round(root[info], precision))
-                elif info == "internal_count":
-                    label += "\ncount: %d" % root[info]
-            graph.node(name, label=label)
-            add(root["left_child"], name, "yes")
-            add(root["right_child"], name, "no")
-        else:
-            name = "leaf%d" % root["leaf_index"]
-            label = "leaf %d: %s" % (root["leaf_index"],
-                                     round(root["leaf_value"], precision))
-            if "leaf_count" in show_info:
-                label += "\ncount: %d" % root["leaf_count"]
-            graph.node(name, label=label)
-        if parent is not None:
-            graph.edge(parent, name, decision)
-
-    graph = Digraph(**kwargs)
-    add(tree_info["tree_structure"])
-    return graph
+    if "split_index" in node:
+        feat = node["split_feature"]
+        feat_name = (feature_names[feat] if feature_names
+                     else "feature %d" % feat)
+        lines = ["%s %s %s" % (feat_name, node.get("decision_type", "<="),
+                               rnd(node["threshold"]))]
+        for key in show_info:
+            if key in ("split_gain", "internal_value"):
+                lines.append("%s: %s" % (key, rnd(node[key])))
+            elif key == "internal_count":
+                lines.append("count: %d" % node[key])
+        return "split%d" % node["split_index"], "\n".join(lines)
+    lines = ["leaf %d: %s" % (node["leaf_index"], rnd(node["leaf_value"]))]
+    if "leaf_count" in show_info:
+        lines.append("count: %d" % node["leaf_count"])
+    return "leaf%d" % node["leaf_index"], "\n".join(lines)
 
 
 def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
                         precision: Optional[int] = 3, **kwargs):
-    """plotting.py:318."""
-    if hasattr(booster, "booster_"):
-        booster = booster.booster_
-    if not isinstance(booster, Booster):
-        raise TypeError("booster must be Booster or LGBMModel")
-    model = booster.dump_model()
-    tree_infos = model["tree_info"]
-    feature_names = model.get("feature_names", None)
-    if tree_index >= len(tree_infos):
-        raise IndexError("tree_index is out of range")
-    if show_info is None:
-        show_info = []
-    return _to_graphviz(tree_infos[tree_index], show_info, feature_names,
-                        precision, **kwargs)
+    """Build a graphviz Digraph of one tree from the dumped model."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("graphviz is required to draw trees") from e
+    bst = _resolve_booster(booster)
+    model = bst.dump_model()
+    trees = model["tree_info"]
+    if not 0 <= tree_index < len(trees):
+        raise IndexError("tree_index %d out of range (model has %d trees)"
+                         % (tree_index, len(trees)))
+    feature_names = model.get("feature_names")
+    show_info = show_info or []
+
+    graph = Digraph(**kwargs)
+    stack = [(trees[tree_index]["tree_structure"], None, None)]
+    while stack:
+        node, parent, branch = stack.pop()
+        name, label = _node_label(node, feature_names, show_info, precision)
+        graph.node(name, label=label)
+        if parent is not None:
+            graph.edge(parent, name, branch)
+        if "split_index" in node:
+            stack.append((node["right_child"], name, "no"))
+            stack.append((node["left_child"], name, "yes"))
+    return graph
 
 
 def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None,
               show_info=None, precision: Optional[int] = 3, **kwargs):
-    """plotting.py:390s: render via graphviz into a matplotlib axis."""
-    try:
-        import matplotlib.pyplot as plt
-        import matplotlib.image as image
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot tree")
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize)
+    """Render one tree into a matplotlib axis (via graphviz png)."""
+    _require_matplotlib("plot trees")
+    import matplotlib.image as mpimg
+    from io import BytesIO
+    ax = _new_axes(ax, figsize)
     graph = create_tree_digraph(booster, tree_index, show_info, precision,
                                 **kwargs)
-    from io import BytesIO
-    s = BytesIO(graph.pipe(format="png"))
-    img = image.imread(s)
-    ax.imshow(img)
+    ax.imshow(mpimg.imread(BytesIO(graph.pipe(format="png"))))
     ax.axis("off")
     return ax

@@ -1,0 +1,98 @@
+"""Phase timing probes — the TIMETAG analog (serial_tree_learner.cpp:15-43).
+
+The boosting iteration is one fused jit program, so per-phase time cannot be
+read from inside it; instead each phase's op is re-run standalone on the
+booster's real shapes and timed. The taxonomy mirrors the reference's
+(init/hist/find-split/split) plus the TPU-specific partition/gather phase.
+``jax.profiler`` traces can be layered on via trace_dir for a full timeline.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timed(fn, *args, reps=3, **kw) -> float:
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
+    """Per-phase seconds for one boosting iteration's building blocks, using
+    the booster's actual data/shapes. Keys: grad, hist_full, hist_leaf,
+    find_split, partition."""
+    from .core.histogram import build_histogram
+    from .core.partition import (hist_for_leaf, init_partition, split_leaf)
+    from .core.split import find_best_split
+
+    xb = booster.xb
+    n = booster.num_data
+    params = booster.grow_params
+    meta = booster.feature_meta
+    out: Dict[str, float] = {}
+
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+    try:
+        scores = booster.scores
+        if booster.objective is not None:
+            obj = booster.objective
+            if booster.num_tree_per_iteration == 1:
+                grad_fn = jax.jit(lambda s: obj.get_gradients(s[:, 0]))
+            else:
+                grad_fn = jax.jit(lambda s: obj.get_gradients(s))
+            out["grad"] = _timed(grad_fn, scores)
+            g, h = grad_fn(scores)
+            if g.ndim == 2:           # multiclass: probe class 0's tree
+                g, h = g[:, 0], h[:, 0]
+        else:
+            g = jnp.zeros((n,), jnp.float32)
+            h = jnp.ones((n,), jnp.float32)
+        mask = jnp.ones((n,), jnp.float32)
+
+        out["hist_full"] = _timed(
+            build_histogram, xb, g, h, mask, num_bins=params.num_bins,
+            row_chunk=params.row_chunk, impl=params.hist_impl)
+        hist = build_histogram(xb, g, h, mask, num_bins=params.num_bins,
+                               row_chunk=params.row_chunk,
+                               impl=params.hist_impl)
+
+        part = init_partition(n, params.num_leaves, params.row_chunk)
+        half = jnp.asarray(np.arange(n) % 2 == 0)
+        hist_leaf_fn = jax.jit(lambda p: hist_for_leaf(
+            p, jnp.int32(0), xb, g, h, mask, params.num_bins,
+            params.row_chunk, impl=params.hist_impl))
+        part2, _ = jax.jit(lambda p: split_leaf(
+            p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),
+            lambda idx: jnp.take(half, idx, mode="clip"),
+            jnp.asarray(True), params.row_chunk))(part)
+        out["partition"] = _timed(
+            jax.jit(lambda p: split_leaf(
+                p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),
+                lambda idx: jnp.take(half, idx, mode="clip"),
+                jnp.asarray(True), params.row_chunk)), part)
+        out["hist_leaf_half"] = _timed(hist_leaf_fn, part2)
+
+        sum_g = jnp.sum(g)
+        sum_h = jnp.sum(h)
+        cnt = jnp.asarray(float(n), jnp.float32)
+        fmask = jnp.ones((meta.num_bin.shape[0],), bool)
+        split_fn = jax.jit(lambda hh: find_best_split(
+            hh, meta, params.split, sum_g, sum_h, cnt, fmask,
+            with_categorical=params.with_categorical))
+        # find_split works on per-feature views; without EFB hist == view
+        if not params.with_efb:
+            out["find_split"] = _timed(split_fn, hist)
+    finally:
+        if trace_dir:
+            jax.profiler.stop_trace()
+    return {k: round(v, 5) for k, v in out.items()}

@@ -1,0 +1,43 @@
+"""Manual probe: per-iteration time vs num_leaves (not collected by pytest).
+
+The O(N x depth) partition path should be roughly flat in num_leaves at
+fixed N; the masked path is ~linear. Run:
+    python tests/perf_scaling_probe.py [rows]
+"""
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+
+def time_iters(n, num_leaves, impl_mode, iters=4):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+
+    r = np.random.RandomState(0)
+    X = r.randn(n, 16).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * r.randn(n)) > 0).astype(np.float32)
+    cfg = Config({"objective": "binary", "num_leaves": num_leaves,
+                  "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    b.grow_params = b.grow_params._replace(use_partition=(impl_mode == "part"))
+    b.train_one_iter()
+    jax.block_until_ready(b.scores)
+    t0 = time.time()
+    for _ in range(iters):
+        b.train_one_iter()
+    jax.block_until_ready(b.scores)
+    return (time.time() - t0) / iters
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    for mode in ("part", "mask"):
+        for leaves in (31, 127, 255):
+            dt = time_iters(n, leaves, mode)
+            print("%s  leaves=%3d  %.3fs/iter" % (mode, leaves, dt), flush=True)

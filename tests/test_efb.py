@@ -1,0 +1,143 @@
+"""EFB (exclusive feature bundling) + sparse ingestion tests.
+
+Covers the dataset.cpp:67-177 semantics: mutually-exclusive sparse features
+share a stored column, training results match the unbundled dense path, and
+sparse input flows in without densifying.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.bundle import find_bundles, bundle_offsets
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.boosting import create_boosting
+
+sp = pytest.importorskip("scipy.sparse")
+
+
+def _exclusive_groups(n=3000, groups=6, per_group=5, seed=3):
+    """Features in blocks of `per_group`, at most one active per row."""
+    r = np.random.RandomState(seed)
+    f = groups * per_group
+    X = np.zeros((n, f))
+    for g in range(groups):
+        which = r.randint(0, per_group + 1, n)   # per_group features + none
+        vals = r.randint(1, 9, n).astype(np.float64)
+        for k in range(per_group):
+            X[which == k, g * per_group + k] = vals[which == k]
+    y = ((X[:, 0] + X[:, per_group] - X[:, 2 * per_group]
+          + 0.5 * r.randn(n)) > 1.0).astype(np.float32)
+    return X, y
+
+
+def test_find_bundles_exclusive_features():
+    r = np.random.RandomState(0)
+    n = 2000
+    nz = []
+    for g in range(4):
+        # 3 exclusive features out of 8 states -> each ~12% nonzero (sparse)
+        which = r.randint(0, 8, n)
+        for k in range(3):
+            nz.append(np.flatnonzero(which == k).astype(np.int64))
+    bundles = find_bundles(nz, n, [10] * 12, max_conflict_rate=0.0)
+    multi = [b for b in bundles if len(b) > 1]
+    assert multi, "mutually exclusive features must bundle"
+    # no bundle may pair features from the same exclusive check twice... every
+    # bundle must be conflict-free: verify on the actual patterns
+    for b in multi:
+        seen = np.zeros(n, dtype=bool)
+        for j in b:
+            assert not (seen[nz[j]]).any(), "conflicting features bundled"
+            seen[nz[j]] = True
+
+
+def test_find_bundles_respects_bin_capacity():
+    n = 1000
+    nz = [np.array([i], dtype=np.int64) for i in range(10)]
+    bundles = find_bundles(nz, n, [200] * 10, max_conflict_rate=0.0)
+    for b in bundles:
+        assert sum(200 for _ in b) + 1 <= 256 or len(b) == 1
+
+
+def test_bundle_offsets_layout():
+    offs, total = bundle_offsets([3, 7, 9], {3: 5, 7: 4, 9: 6})
+    assert offs == [1, 6, 10]
+    assert total == 16
+    offs1, total1 = bundle_offsets([4], {4: 17})
+    assert offs1 == [0] and total1 == 17
+
+
+def test_sparse_input_bundles_and_matches_dense():
+    X, y = _exclusive_groups()
+    Xs = sp.csr_matrix(X)
+    cfg = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 5})
+    ds_b = BinnedDataset.from_matrix(Xs, cfg, label=y)
+    assert ds_b.has_bundles
+    assert ds_b.num_columns < ds_b.num_features / 2
+    cfg_nb = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 5, "enable_bundle": False})
+    ds_d = BinnedDataset.from_matrix(X, cfg_nb, label=y)
+    assert ds_d.num_columns == ds_d.num_features
+
+    b1 = create_boosting(cfg, ds_b, create_objective(cfg), [])
+    b2 = create_boosting(cfg_nb, ds_d, create_objective(cfg_nb), [])
+    for _ in range(5):
+        b1.train_one_iter()
+        b2.train_one_iter()
+    p1 = b1.predict(X[:200], raw_score=True)
+    p2 = b2.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_dense_same_binning():
+    """CSR and dense inputs of the same data produce identical bin matrices
+    when bundling is off (the sparse path is not allowed to drift)."""
+    X, y = _exclusive_groups(n=800, groups=3)
+    cfg = Config({"objective": "binary", "verbosity": -1,
+                  "enable_bundle": False})
+    ds1 = BinnedDataset.from_matrix(X, cfg, label=y)
+    ds2 = BinnedDataset.from_matrix(sp.csr_matrix(X), cfg, label=y)
+    np.testing.assert_array_equal(ds1.X_binned, ds2.X_binned)
+    for m1, m2 in zip(ds1.bin_mappers, ds2.bin_mappers):
+        assert m1.num_bin == m2.num_bin
+        np.testing.assert_allclose(m1.bin_upper_bound, m2.bin_upper_bound)
+
+
+def test_efb_binary_cache_roundtrip(tmp_path):
+    X, y = _exclusive_groups(n=600, groups=3)
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    ds = BinnedDataset.from_matrix(sp.csr_matrix(X), cfg, label=y)
+    path = str(tmp_path / "cache.npz")
+    ds.save_binary(path)
+    ds2 = BinnedDataset.load_binary(path)
+    np.testing.assert_array_equal(ds.X_binned, ds2.X_binned)
+    assert ds.col_features == ds2.col_features
+    assert ds.col_offsets == ds2.col_offsets
+    assert ds.col_num_bin == ds2.col_num_bin
+
+
+def test_efb_with_validation_set():
+    """Validation sets built against an EFB reference reuse its layout."""
+    X, y = _exclusive_groups()
+    Xv, yv = _exclusive_groups(seed=11)
+    cfg = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "metric": "binary_logloss"})
+    ds = BinnedDataset.from_matrix(sp.csr_matrix(X), cfg, label=y)
+    dv = BinnedDataset.from_matrix(sp.csr_matrix(Xv), cfg, label=yv,
+                                   reference=ds)
+    assert dv.col_features == ds.col_features
+    assert dv.X_binned.shape[1] == ds.X_binned.shape[1]
+    from lightgbm_tpu.metrics import create_metric
+    b = create_boosting(cfg, ds, create_objective(cfg),
+                        [create_metric("binary_logloss", cfg)])
+    b.add_valid_data(dv, [create_metric("binary_logloss", cfg)])
+    for _ in range(8):
+        b.train_one_iter()
+    (_, _, train_ll, _), = b.get_eval_at(0)
+    (_, _, valid_ll, _), = b.get_eval_at(1)
+    assert train_ll < 0.6
+    assert valid_ll < 0.75
