@@ -89,13 +89,21 @@ class Dataset:
             if data.endswith(".npz") or data.endswith(".bin"):
                 self._binned = BinnedDataset.load_binary(data)
                 return self
-            from .io.parser import parse_file
-            X, y, names = parse_file(data, has_header=cfg.header,
-                                     label_column=cfg.label_column)
+            from .io import parser as parser_mod
+            X, y, names = parser_mod.parse_file(data, has_header=cfg.header,
+                                                label_column=cfg.label_column)
             if self.label is None:
                 self.label = y
             if self.feature_name == "auto" and names:
                 self.feature_name = names
+            # sidecar metadata files (<data>.weight/.query/.init), the
+            # Metadata file convention (src/io/metadata.cpp LoadFromFile)
+            if self.weight is None:
+                self.weight = parser_mod.load_weight_file(data)
+            if self.group is None:
+                self.group = parser_mod.load_query_file(data)
+            if self.init_score is None:
+                self.init_score = parser_mod.load_init_score_file(data)
             data = X
 
         from .io.dataset import _is_sparse

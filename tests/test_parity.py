@@ -1,0 +1,159 @@
+"""Golden parity vs the reference implementation.
+
+Artifacts in tests/golden/ were produced by the reference CLI (v2.2.4 built
+from /root/reference) on examples/binary_classification with:
+  objective=binary num_trees=20 learning_rate=0.1 num_leaves=31 max_bin=255
+  min_data_in_leaf=20 num_threads=1
+- model_ref.txt      : reference-written model file
+- pred_ref[_raw].txt : reference predictions on binary.test
+- trajectory_ref.json: per-iteration train/valid auc + binary_logloss
+
+These pin three contracts: (a) reference model files load and predict
+identically (gbdt_model_text.cpp format interop), (b) training on the same
+data + params reproduces the reference metric trajectory, (c) tree structure
+parity — identical split features and thresholds for the first trees, which
+transitively pins bin boundaries (bin.cpp FindBin) and split selection
+(feature_histogram.hpp gain math).
+"""
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.parser import parse_file
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+REF_DATA = "/root/reference/examples/binary_classification"
+
+needs_ref_data = pytest.mark.skipif(
+    not os.path.exists(os.path.join(REF_DATA, "binary.train")),
+    reason="reference example data not available")
+
+
+def _load(name):
+    return parse_file(os.path.join(REF_DATA, name), has_header=False,
+                      label_column="0")
+
+
+@needs_ref_data
+def test_reference_model_file_predicts_identically():
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model_ref.txt"))
+    X, _, _ = _load("binary.test")
+    raw = bst.predict(X, raw_score=True)
+    golden_raw = np.loadtxt(os.path.join(GOLDEN, "pred_ref_raw.txt"))
+    assert np.abs(raw - golden_raw).max() < 1e-6
+    prob = bst.predict(X)
+    golden_prob = np.loadtxt(os.path.join(GOLDEN, "pred_ref.txt"))
+    assert np.abs(prob - golden_prob).max() < 1e-6
+
+
+def _train_like_reference():
+    X, y, _ = _load("binary.train")
+    Xv, yv, _ = _load("binary.test")
+    params = {"objective": "binary", "metric": ["auc", "binary_logloss"],
+              "num_leaves": 31, "learning_rate": 0.1, "max_bin": 255,
+              "min_data_in_leaf": 20, "verbosity": -1}
+    dtr = lgb.Dataset(X, y)
+    dv = lgb.Dataset(Xv, yv, reference=dtr)
+    ev = {}
+    bst = lgb.train(params, dtr, num_boost_round=20, valid_sets=[dtr, dv],
+                    valid_names=["training", "valid_1"], evals_result=ev,
+                    verbose_eval=False)
+    return bst, ev
+
+
+@needs_ref_data
+def test_training_trajectory_matches_reference():
+    _, ev = _train_like_reference()
+    traj = json.load(open(os.path.join(GOLDEN, "trajectory_ref.json")))
+    for ds in ("training", "valid_1"):
+        for metric, tol in (("auc", 2e-4), ("binary_logloss", 5e-4)):
+            ref_series = [v for _, v in traj[ds][metric]]
+            ours = ev[ds][metric]
+            assert len(ours) == len(ref_series)
+            diffs = np.abs(np.asarray(ours) - np.asarray(ref_series))
+            assert diffs.max() < tol, (ds, metric, diffs.max())
+
+
+@needs_ref_data
+def test_tree_structure_parity():
+    """First trees must be structurally identical: same split features and
+    same real-valued thresholds (pins FindBin + split search end to end)."""
+    bst, _ = _train_like_reference()
+    ours = bst.model_to_string()
+    ref = open(os.path.join(GOLDEN, "model_ref.txt")).read()
+
+    def tree_block(text, i):
+        return text.split("Tree=%d" % i)[1].split("Tree=")[0]
+
+    def field(block, key):
+        return re.search(key + r"=([^\n]+)", block).group(1).split()
+
+    for i in range(3):
+        to, tr = tree_block(ours, i), tree_block(ref, i)
+        assert field(to, "split_feature") == field(tr, "split_feature"), i
+        th_o = np.asarray(field(to, "threshold"), np.float64)
+        th_r = np.asarray(field(tr, "threshold"), np.float64)
+        np.testing.assert_allclose(th_o, th_r, rtol=0, atol=1e-9)
+        lv_o = np.asarray(field(to, "leaf_value"), np.float64)
+        lv_r = np.asarray(field(tr, "leaf_value"), np.float64)
+        # f32 histogram accumulation vs the reference's f64 leaves tiny
+        # per-leaf drift; the trajectory test bounds its cumulative effect
+        np.testing.assert_allclose(lv_o, lv_r, rtol=1e-4, atol=1e-5)
+
+
+@needs_ref_data
+def test_regression_parity_with_init_score_files():
+    """examples/regression ships <data>.init sidecar files; training must
+    load them (metadata.cpp LoadFromFile) and land exactly on the reference
+    CLI's l2 trajectory endpoints (num_threads=1, 20 iters)."""
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 31,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 20,
+              "verbosity": -1}
+    dtr = lgb.Dataset("/root/reference/examples/regression/regression.train")
+    dv = lgb.Dataset("/root/reference/examples/regression/regression.test",
+                     reference=dtr)
+    ev = {}
+    lgb.train(params, dtr, num_boost_round=20, valid_sets=[dtr, dv],
+              valid_names=["training", "valid_1"], evals_result=ev,
+              verbose_eval=False)
+    assert abs(ev["training"]["l2"][-1] - 0.234897) < 5e-5
+    assert abs(ev["valid_1"]["l2"][-1] - 0.257987) < 5e-5
+    assert abs(ev["training"]["l2"][0] - 0.316172) < 5e-5
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/examples/lambdarank/rank.train"),
+    reason="reference lambdarank data not available")
+def test_lambdarank_parity():
+    """NDCG trajectory parity on examples/lambdarank (reference CLI:
+    ndcg@1/3/5 = 0.94679/0.94353/0.931069 at iteration 20)."""
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": [1, 3, 5], "num_leaves": 31, "learning_rate": 0.1,
+              "max_bin": 255, "min_data_in_leaf": 20, "verbosity": -1}
+    dtr = lgb.Dataset("/root/reference/examples/lambdarank/rank.train")
+    ev = {}
+    lgb.train(params, dtr, num_boost_round=20, valid_sets=[dtr],
+              valid_names=["training"], evals_result=ev, verbose_eval=False)
+    for k, ref in ((1, 0.94679), (3, 0.94353), (5, 0.931069)):
+        assert abs(ev["training"]["ndcg@%d" % k][-1] - ref) < 2e-3, k
+
+
+@needs_ref_data
+def test_feature_infos_parity():
+    """Model-file feature_infos ([min:max] ranges) match the reference's —
+    a direct check on the sampled value handling in bin construction."""
+    bst, _ = _train_like_reference()
+    ours = re.search(r"feature_infos=([^\n]+)", bst.model_to_string()).group(1)
+    ref = re.search(r"feature_infos=([^\n]+)",
+                    open(os.path.join(GOLDEN, "model_ref.txt")).read()).group(1)
+
+    def ranges(text):
+        return [tuple(float(v) for v in item.strip("[]").split(":"))
+                for item in text.split()]
+
+    for (a1, b1), (a2, b2) in zip(ranges(ours), ranges(ref)):
+        assert abs(a1 - a2) < 1e-12 and abs(b1 - b2) < 1e-12
