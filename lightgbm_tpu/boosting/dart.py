@@ -27,6 +27,10 @@ class DART(GBDT):
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
+        # DART reads/normalizes stored trees around every iteration, so the
+        # async driver's deferred materialization would break its
+        # stop-rollback path; flush each iteration.
+        self._flush_every = 1
 
     def _dropping_trees(self) -> List[int]:
         """Select iteration indices to drop (dart.hpp DroppingTrees:88-139)."""

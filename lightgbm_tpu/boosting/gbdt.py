@@ -34,6 +34,7 @@ from ..io.dataset import BinnedDataset
 from ..io.binning import BinType, MissingType as BinMissingType
 from ..core.split import FeatureMeta, SplitParams
 from ..core.grow import GrowParams, TreeArrays, empty_tree, grow_tree
+from ..core.pack import pack_trees, unpack_tree
 from ..core import tree as tree_mod
 from ..objectives import ObjectiveFunction
 from ..metrics import Metric
@@ -166,7 +167,16 @@ class GBDT:
         self.train_metrics = metrics or []
         self.valid_data: List[BinnedDataset] = []
         self.valid_metrics: List[List[Metric]] = []
-        self.models: List[HostTree] = []
+        # Async driver state: trained trees stay on device ([K, T] packed
+        # int32 buffers, core/pack.py) and are materialized to HostTrees in
+        # batched flushes — one device->host transfer per flush instead of
+        # ~20 per iteration. `_models` is the materialized list; `models` is
+        # a flushing property.
+        self._models: List[HostTree] = []
+        self._pending: List[Dict[str, Any]] = []
+        self._stopped = False
+        self._stopped_dev = jnp.asarray(False)  # device-side stop latch
+        self._flush_every = 64
         self.iter_ = 0
         self.num_init_iteration = 0
         self.best_score: Dict[Any, Dict[str, float]] = {}
@@ -240,10 +250,22 @@ class GBDT:
         for m in self.train_metrics:
             m.init(ds.metadata, ds.num_data)
 
+        self._forced_splits, num_forced = self._setup_forced_splits()
+        self._cegb_state = self._setup_cegb()
+        if cfg.tree_learner == "voting" and self.mesh is not None and \
+                (num_forced > 0 or self._cegb_state is not None):
+            raise LightGBMError("forced splits / CEGB are not supported "
+                                "with the voting-parallel tree learner")
+
         self.grow_params = GrowParams(
             num_leaves=cfg.num_leaves,
             num_bins=self.num_bins,
             max_depth=cfg.max_depth,
+            num_forced=num_forced,
+            cegb_split_penalty=float(cfg.cegb_tradeoff
+                                     * cfg.cegb_penalty_split),
+            with_cegb_coupled=bool(len(cfg.cegb_penalty_feature_coupled)),
+            with_cegb_lazy=bool(len(cfg.cegb_penalty_feature_lazy)),
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
                 max_delta_step=cfg.max_delta_step,
@@ -332,6 +354,84 @@ class GBDT:
             self.init_score_offsets = np.zeros(k, np.float32)
         self.boost_from_average_done = True
 
+    def _setup_forced_splits(self):
+        """Parse forcedsplits_filename into BFS step arrays (the ForceSplits
+        queue walk, serial_tree_learner.cpp:593-751, linearized at setup
+        because the leaf numbering is deterministic: step t's right child
+        is leaf t + 1). Returns (ForcedSplits | None, count)."""
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return None, 0
+        import json as _json
+        from collections import deque
+        with open(fname) as fh:
+            root = _json.load(fh)
+        if not root:
+            return None, 0
+        ds = self.train_data
+        inner_of = {real: i for i, real in enumerate(ds.used_features)}
+        leaf_arr: List[int] = []
+        feat_arr: List[int] = []
+        thr_arr: List[int] = []
+        q = deque([(root, 0)])
+        t = 0
+        while q and t < self.config.num_leaves - 1:
+            node, leaf = q.popleft()
+            real_f = int(node["feature"])
+            check(real_f in inner_of,
+                  "forced split feature %d is trivial/unused" % real_f)
+            mapper = ds.bin_mappers[real_f]
+            check(mapper.bin_type != BinType.CATEGORICAL,
+                  "forced splits on categorical features are not supported")
+            # rows with bin < ValueToBin(threshold) go left (BinThreshold,
+            # dataset.h:507); our convention is `<= bin`, so -1 legitimately
+            # means "empty left" — the forced split then aborts on
+            # left_count == 0, like the reference's negative-gain gather
+            tb = mapper.value_to_bin(float(node["threshold"])) - 1
+            leaf_arr.append(leaf)
+            feat_arr.append(inner_of[real_f])
+            thr_arr.append(tb)
+            right_leaf = t + 1
+            if isinstance(node.get("left"), dict):
+                q.append((node["left"], leaf))
+            if isinstance(node.get("right"), dict):
+                q.append((node["right"], right_leaf))
+            t += 1
+        from ..core.grow import ForcedSplits
+        return ForcedSplits(leaf=jnp.asarray(leaf_arr, jnp.int32),
+                            feature=jnp.asarray(feat_arr, jnp.int32),
+                            threshold=jnp.asarray(thr_arr, jnp.int32)), t
+
+    def _setup_cegb(self):
+        """CEGB acquisition state (device-resident, persists across trees —
+        SerialTreeLearner feature_used / feature_used_in_data,
+        serial_tree_learner.cpp:103-112). None when CEGB is off."""
+        cfg = self.config
+        coupled = list(cfg.cegb_penalty_feature_coupled)
+        lazy = list(cfg.cegb_penalty_feature_lazy)
+        if not coupled and not lazy and cfg.cegb_penalty_split <= 0:
+            return None
+        from ..core.grow import CegbState
+        f = int(self.feature_meta.num_bin.shape[0])
+        ds = self.train_data
+        coupled_arr = np.zeros(f, np.float32)
+        lazy_arr = np.zeros(f, np.float32)
+        for i, real in enumerate(ds.used_features):
+            if coupled:
+                check(real < len(coupled), "cegb_penalty_feature_coupled "
+                      "must cover every feature")
+                coupled_arr[i] = cfg.cegb_tradeoff * float(coupled[real])
+            if lazy:
+                check(real < len(lazy), "cegb_penalty_feature_lazy "
+                      "must cover every feature")
+                lazy_arr[i] = cfg.cegb_tradeoff * float(lazy[real])
+        n_lazy = self.num_data if lazy else 0
+        return CegbState(
+            coupled_penalty=jnp.asarray(coupled_arr),
+            lazy_penalty=jnp.asarray(lazy_arr),
+            feature_used=jnp.zeros((f,), bool),
+            row_used=jnp.zeros((f, n_lazy), jnp.uint8))
+
     def _sample_feature_mask(self) -> jnp.ndarray:
         """Per-tree column sampling (serial_tree_learner.cpp:271-292)."""
         f = self.train_data.num_features
@@ -382,9 +482,12 @@ class GBDT:
             other_cnt = max(1, int(n_real * self.config.other_rate))
             goss_multiply = float(n_real - top_cnt) / other_cnt
 
+        forced_splits = self._forced_splits
+
         @jax.jit
         def run_iter(scores, sample_mask, feature_mask,
-                     grad_in, hess_in, lr, goss_active, goss_key):
+                     grad_in, hess_in, lr, goss_active, goss_key,
+                     cegb_state, stopped_in):
             # gradients: objective or custom (grad_in) (gbdt.cpp:333-347)
             if not use_input:
                 if k == 1:
@@ -432,36 +535,86 @@ class GBDT:
                 grow_sharded = jax.shard_map(
                     lambda xbj, gj, hj, mj, fm: grow_tree(
                         xbj, gj, hj, mj, meta, fm, params,
-                        axis_name=DATA_AXIS),
+                        axis_name=DATA_AXIS)[:2],
                     mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
                                          P(DATA_AXIS), P(DATA_AXIS), P()),
                     out_specs=(tree_spec, P(DATA_AXIS)), check_vma=False)
 
-                def grow_one(gk, hk):
-                    return grow_sharded(xb, gk, hk, sample_mask, feature_mask)
+                def grow_one(gk, hk, cs):
+                    t, li = grow_sharded(xb, gk, hk, sample_mask,
+                                         feature_mask)
+                    return t, li, None
             else:
-                def grow_one(gk, hk):
+                def grow_one(gk, hk, cs):
                     return grow_tree(xb, gk, hk, sample_mask, meta,
-                                     feature_mask, params)
+                                     feature_mask, params,
+                                     forced=forced_splits, cegb=cs)
 
-            trees, leaf_ids = jax.vmap(grow_one, in_axes=(1, 1))(g, h)
+            trees, leaf_ids, cegb_out = jax.vmap(
+                grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
+            if cegb_state is not None:
+                # classes train from the iteration-start state; acquisitions
+                # merge across class trees for the next iteration (the
+                # sequential-classes analog of the reference's shared
+                # learner state)
+                cegb_new = cegb_state._replace(
+                    feature_used=jnp.any(cegb_out.feature_used, axis=0),
+                    row_used=jnp.max(cegb_out.row_used, axis=0))
+            else:
+                cegb_new = None
             # score update fast path: leaf_id -> leaf_value (shrinkage applied)
             deltas = jax.vmap(
                 lambda t, li: t.leaf_value[li] * lr)(trees, leaf_ids)  # [K, N]
-            new_scores = scores + deltas.T
-            return trees, leaf_ids, new_scores, g, h
+            # A fully-stumped iteration (no class tree split) means training
+            # has converged; the reference discards the tree and stops
+            # (gbdt.cpp:379-396). The stop flag accumulates ON DEVICE across
+            # iterations: once any iteration stumps, every later dispatched
+            # iteration freezes the scores too — so the async driver can
+            # discard the overshoot trees at the next flush without
+            # rewinding anything, even when bagging/feature sampling would
+            # have let a later iteration split again.
+            any_split = jnp.any(trees.num_leaves > 1)
+            stopped_out = stopped_in | ~any_split
+            apply = (any_split & ~stopped_in).astype(jnp.float32)
+            new_scores = scores + deltas.T * apply
+            return pack_trees(trees), leaf_ids, new_scores, cegb_new, \
+                stopped_out
 
         return run_iter
 
     def _goss_active(self, iter_idx: int) -> float:
         return 0.0
 
+    @property
+    def models(self) -> List[HostTree]:
+        """Materialized HostTrees; flushes any pending device trees first."""
+        self._materialize()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[HostTree]) -> None:
+        # wholesale assignment (model load / refit) discards pending work
+        self._pending.clear()
+        self._stopped = False
+        self._stopped_dev = jnp.asarray(False)
+        self._models = list(value)
+
+    @property
+    def _needs_host_per_iter(self) -> bool:
+        return getattr(self.objective, "renew_percentile", None) is not None
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp TrainOneIter:333-412).
 
-        Returns True when training should stop (no splittable tree).
+        Returns True when training should stop (no splittable tree). The
+        iteration is dispatched asynchronously: trees stay on device and
+        host materialization is deferred to `_materialize` (so the stop may
+        be reported up to `_flush_every` iterations late; the in-graph
+        score gating makes the overshoot iterations exact no-ops).
         """
+        if self._stopped:
+            return True
         self._boost_from_average()
         if self._compiled_iter is None:
             self._compiled_iter = self._make_train_iter_fn()
@@ -485,49 +638,87 @@ class GBDT:
             h_in = jnp.ones((n, k), jnp.float32)
 
         self._bag_key, goss_key = jax.random.split(self._bag_key)
-        trees, leaf_ids, new_scores, g, h = self._compiled_iter(
-            self.scores, sample_mask, feature_mask, g_in, h_in,
-            jnp.float32(self.shrinkage_rate),
-            jnp.float32(self._goss_active(iter_idx)), goss_key)
+        prev_scores = self.scores
+        packed, leaf_ids, new_scores, cegb_new, self._stopped_dev = \
+            self._compiled_iter(
+                self.scores, sample_mask, feature_mask, g_in, h_in,
+                jnp.float32(self.shrinkage_rate),
+                jnp.float32(self._goss_active(iter_idx)), goss_key,
+                self._cegb_state, self._stopped_dev)
+        self.scores = new_scores
+        self._cegb_state = cegb_new
 
-        # pull tree arrays to host, convert thresholds, store
-        trees_np = jax.tree.map(np.asarray, trees)
-        any_split = False
-        host_trees = []
-        for c in range(k):
-            t = jax.tree.map(lambda a: a[c], trees_np)
-            ht = self._extract_host_tree(t)
-            if ht.num_leaves_actual > 1:
-                any_split = True
-            host_trees.append(ht)
+        pend: Dict[str, Any] = {"packed": packed,
+                                "shrinkage": self.shrinkage_rate}
+        if self._needs_host_per_iter:
+            pend.update(leaf_ids=leaf_ids, sample_mask=sample_mask,
+                        prev_scores=prev_scores)
+        self._pending.append(pend)
+        self.iter_ += 1
+        if self._needs_host_per_iter or \
+                len(self._pending) >= self._flush_every:
+            return self._materialize()
+        return False
 
-        if not any_split:
-            Log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements")
-            if not self.models:
-                # keep a constant tree so the model reproduces the init score
-                # (AsConstantTree path, gbdt.cpp:379-396)
-                inits = getattr(self, "init_score_offsets",
-                                np.zeros(k, np.float32))
-                for c in range(k):
-                    ht = host_trees[c]
-                    ht.num_leaves_actual = 1
-                    ht.leaf_value[:] = 0.0
-                    ht.leaf_value[0] = float(inits[c])
-                    ht.split_leaf[:] = -1
-                    self.models.append(ht)
-            return True
+    def _materialize(self) -> bool:
+        """Flush pending device trees to HostTrees (one batched transfer).
 
+        Returns True if training has stopped (a fully-stumped iteration was
+        found; later pending iterations are no-ops by construction and are
+        discarded).
+        """
+        if not self._pending:
+            return self._stopped
+        pend, self._pending = self._pending, []
+        k = self.num_tree_per_iteration
+        l = self.config.num_leaves
+        buf = np.asarray(jnp.stack([p["packed"] for p in pend]))  # [P, K, T]
+        for pi, p in enumerate(pend):
+            host_trees = []
+            any_split = False
+            for c in range(k):
+                t = unpack_tree(buf[pi, c], l)
+                ht = self._extract_host_tree(t)
+                if ht.num_leaves_actual > 1:
+                    any_split = True
+                host_trees.append(ht)
+            if not any_split:
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                if not self._models:
+                    # keep a constant tree so the model reproduces the init
+                    # score (AsConstantTree path, gbdt.cpp:379-396)
+                    inits = getattr(self, "init_score_offsets",
+                                    np.zeros(k, np.float32))
+                    for c in range(k):
+                        ht = host_trees[c]
+                        ht.num_leaves_actual = 1
+                        ht.leaf_value[:] = 0.0
+                        ht.leaf_value[0] = float(inits[c])
+                        ht.split_leaf[:] = -1
+                        self._models.append(ht)
+                self._stopped = True
+                self.iter_ = len(self._models) // max(k, 1)
+                break
+            self._store_host_trees(host_trees, p)
+        return self._stopped
+
+    def _store_host_trees(self, host_trees: List[HostTree],
+                          pend: Dict[str, Any]) -> None:
+
+        """Renew/shrink/bias-fold one flushed iteration's trees and append
+        them to the model list (the tail of the reference's TrainOneIter)."""
+        k = self.num_tree_per_iteration
         # leaf renewal for percentile objectives (RenewTreeOutput,
         # serial_tree_learner.cpp:850-928)
-        if getattr(self.objective, "renew_percentile", None) is not None:
-            new_scores = self._renew_tree_outputs(host_trees, leaf_ids,
-                                                  sample_mask)
-        self.scores = new_scores
+        if self._needs_host_per_iter:
+            self.scores = self._renew_tree_outputs(
+                host_trees, pend["leaf_ids"], pend["sample_mask"],
+                pend["prev_scores"])
 
-        first_iter = not self.models
+        first_iter = not self._models
         for ht in host_trees:
-            ht.shrink(self.shrinkage_rate)
+            ht.shrink(pend["shrinkage"])
         # valid scores get the shrunk tree output (pre-bias; their init score
         # was added by _boost_from_average already)
         self._update_valid_scores(host_trees)
@@ -539,14 +730,14 @@ class GBDT:
                 if abs(float(inits[c])) > 1e-15:
                     ht.leaf_value += float(inits[c])
                     ht.internal_value += float(inits[c])
-        self.models.extend(host_trees)
-        self.iter_ += 1
-        return False
+        self._models.extend(host_trees)
 
     def _renew_tree_outputs(self, host_trees: List[HostTree],
-                            leaf_ids, sample_mask) -> jnp.ndarray:
+                            leaf_ids, sample_mask,
+                            prev_scores) -> jnp.ndarray:
         """Percentile leaf refit for L1/quantile/MAPE objectives
-        (regression_objective.hpp RenewTreeOutput; host-side for now)."""
+        (regression_objective.hpp RenewTreeOutput; host-side for now).
+        ``prev_scores`` are the scores BEFORE this iteration's tree."""
         alpha = self.objective.renew_percentile()
         n0 = self.num_data_orig
         label = np.asarray(self.objective.label)[:n0]
@@ -555,7 +746,7 @@ class GBDT:
         if hasattr(self.objective, "label_weight") and \
                 self.objective.name == "mape":
             w = np.asarray(self.objective.label_weight)[:n0]
-        scores_np = np.array(self.scores)
+        scores_np = np.array(prev_scores)
         leaf_ids_np = np.asarray(leaf_ids)
         mask = np.asarray(sample_mask)[:n0] > 0
         k = self.num_tree_per_iteration
@@ -688,6 +879,8 @@ class GBDT:
         """Eval metrics for data_idx (0=train, 1..=valid); returns
         (data_name, metric_name, value, bigger_better) tuples
         (gbdt.cpp OutputMetric:476-533)."""
+        # valid-set score caches advance at materialization time
+        self._materialize()
         out = []
         conv = (self.objective.convert_output if self.objective is not None
                 else None)
@@ -803,7 +996,11 @@ class GBDT:
 
     @property
     def current_iteration(self) -> int:
-        return len(self.models) // max(self.num_tree_per_iteration, 1)
+        # must materialize: dispatched iterations past a device-detected
+        # stop get discarded at flush, so the pending count alone would
+        # overstate the model length (and poison best_iteration)
+        self._materialize()
+        return len(self._models) // max(self.num_tree_per_iteration, 1)
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:

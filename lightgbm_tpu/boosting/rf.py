@@ -37,6 +37,9 @@ class RF(GBDT):
         # the iteration count (score_updater MultiplyScore dance, rf.hpp)
         self._score_sum = self.scores
         self._valid_score_sum = {}
+        # RF rewrites each iteration's trees (AddBias) and re-averages scores
+        # immediately after training them; flush synchronously.
+        self._flush_every = 1
 
     def _fixed_gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Gradients at the constant init score (rf.hpp Boosting :76-95)."""

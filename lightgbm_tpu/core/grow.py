@@ -34,9 +34,9 @@ from jax import lax
 
 from .histogram import build_histogram
 from .partition import RowPartition, hist_for_leaf, init_partition, split_leaf
-from .split import (BestSplit, FeatureMeta, SplitParams, K_MIN_SCORE,
-                    MISSING_NAN, MISSING_NONE, MISSING_ZERO,
-                    calculate_leaf_output, find_best_split,
+from .split import (BestSplit, FeatureMeta, SplitParams, K_EPSILON,
+                    K_MIN_SCORE, MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                    calculate_leaf_output, find_best_split, leaf_split_gain,
                     per_feature_split_merged)
 
 
@@ -67,6 +67,17 @@ class GrowParams(NamedTuple):
     # meta.col/offset. num_feat_bins = 0 means "same as num_bins".
     with_efb: bool = False
     num_feat_bins: int = 0
+    # forced splits (serial_tree_learner.cpp ForceSplits :593-751): the
+    # first `num_forced` loop steps split a BFS-predetermined (leaf,
+    # feature, threshold) instead of the best-gain candidate
+    num_forced: int = 0
+    # CEGB (serial_tree_learner.cpp :533-539): per-candidate gain penalties.
+    # cegb_split_penalty is tradeoff * cegb_penalty_split (scaled by leaf
+    # count at evaluation time); coupled/lazy switches enable the
+    # feature-acquisition terms carried in CegbState.
+    cegb_split_penalty: float = 0.0
+    with_cegb_coupled: bool = False
+    with_cegb_lazy: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -127,6 +138,29 @@ def empty_tree(num_leaves: int) -> TreeArrays:
     )
 
 
+class ForcedSplits(NamedTuple):
+    """BFS-linearized forcedsplits_filename JSON (ForceSplits,
+    serial_tree_learner.cpp:593-751). Step ``t < num_forced`` splits
+    ``leaf[t]`` on ``feature[t]`` at feature-space bin ``threshold[t]``
+    (rows with bin <= threshold go left). The leaf indices are computable
+    at setup time because the node numbering is deterministic: step t's
+    right child is always leaf t + 1."""
+    leaf: jnp.ndarray       # [Q] int32
+    feature: jnp.ndarray    # [Q] int32 (inner feature index)
+    threshold: jnp.ndarray  # [Q] int32 (feature-space bin)
+
+
+class CegbState(NamedTuple):
+    """Cost-Effective Gradient Boosting acquisition state. Persists across
+    trees (a SerialTreeLearner member in the reference, reset only with the
+    training data): once a feature is bought, later splits on it are free."""
+    coupled_penalty: jnp.ndarray  # [F] f32, tradeoff * penalty_feature_coupled
+    lazy_penalty: jnp.ndarray     # [F] f32, tradeoff * penalty_feature_lazy
+    feature_used: jnp.ndarray     # [F] bool — any split on f so far
+    row_used: jnp.ndarray         # [F, N] uint8 — row paid for f (lazy);
+    #                               [F, 0] when lazy penalties are off
+
+
 class _GrowState(NamedTuple):
     leaf_id: jnp.ndarray      # [N] int32
     hist_pool: jnp.ndarray    # [L, F, B, 3] f32 per-leaf histograms
@@ -135,6 +169,10 @@ class _GrowState(NamedTuple):
     leaf_min: jnp.ndarray     # [L] f32 monotone lower output bound
     leaf_max: jnp.ndarray     # [L] f32 monotone upper output bound
     part: Optional[RowPartition]  # row partition (use_partition mode only)
+    cegb: Optional[CegbState]     # CEGB acquisition state (None = off)
+    force_aborted: jnp.ndarray    # scalar bool — a forced split failed;
+    #                               remaining forced steps fall back to
+    #                               best-first (aborted_last_force_split)
 
 
 def _empty_best(num_leaves: int) -> BestSplit:
@@ -190,9 +228,12 @@ def _bin_go_left(col: jnp.ndarray, threshold: jnp.ndarray,
 def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray, meta: FeatureMeta,
               feature_mask: jnp.ndarray, params: GrowParams,
-              axis_name: Optional[str] = None
-              ) -> Tuple[TreeArrays, jnp.ndarray]:
-    """Grow one leaf-wise tree; returns (tree, final per-row leaf_id).
+              axis_name: Optional[str] = None,
+              forced: Optional[ForcedSplits] = None,
+              cegb: Optional[CegbState] = None,
+              ) -> Tuple[TreeArrays, jnp.ndarray, Optional[CegbState]]:
+    """Grow one leaf-wise tree; returns (tree, final per-row leaf_id,
+    updated CEGB state or None).
 
     xb [N, F] uint8 binned features; grad/hess [N] f32 (objective-weighted);
     sample_mask [N] f32 bagging inclusion. With ``axis_name`` set, rows are
@@ -242,21 +283,43 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return jnp.where((is_def & meta.bundled[:, None])[..., None],
                          rebuilt[:, None, :], out)
 
+    def cegb_gain_penalty(cegb_state, cnt, leaf_mask):
+        """[F] CEGB penalty for one candidate leaf
+        (serial_tree_learner.cpp:533-539): split cost scales with leaf
+        size; coupled cost applies to never-bought features; lazy cost
+        counts the leaf's rows that haven't paid for the feature yet
+        (CalculateOndemandCosts, :484-504)."""
+        if cegb_state is None:
+            return None
+        pen = jnp.full((f,), params.cegb_split_penalty * cnt, jnp.float32)
+        if params.with_cegb_coupled:
+            pen = pen + jnp.where(cegb_state.feature_used, 0.0,
+                                  cegb_state.coupled_penalty)
+        if params.with_cegb_lazy:
+            unpaid = psum(jnp.sum(
+                leaf_mask[None, :] * (1.0 - cegb_state.row_used
+                                      .astype(jnp.float32)), axis=1))  # [F]
+            pen = pen + cegb_state.lazy_penalty * unpaid
+        return pen
+
     def full_best(hist, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
-                  max_c=jnp.inf):
+                  max_c=jnp.inf, gain_penalty=None):
         bs = find_best_split(expand(hist, sum_g, sum_h, cnt), meta, sp,
                              sum_g, sum_h, cnt,
                              feature_mask, min_constraint=min_c,
                              max_constraint=max_c,
-                             with_categorical=params.with_categorical)
+                             with_categorical=params.with_categorical,
+                             gain_penalty=gain_penalty)
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
 
     def voting_best(hist_local, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
-                    max_c=jnp.inf):
+                    max_c=jnp.inf, gain_penalty=None):
         """PV-Tree candidate election (voting_parallel_tree_learner.cpp:
         166-360): rank-local top-k proposals from local-histogram gains, a
         global vote elects <=2*top_k features, and only those features'
         histograms are summed across the mesh (comm O(2k*B) vs O(F*B))."""
+        assert gain_penalty is None, \
+            "CEGB is not supported with the voting-parallel learner"
         k = min(params.voting_top_k, f)
         k2 = min(2 * params.voting_top_k, f)
         # local leaf totals from the local histogram itself: every local row
@@ -299,7 +362,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_weight=tree.leaf_weight.at[0].set(root_h),
         leaf_count=tree.leaf_count.at[0].set(root_c))
 
-    best0 = best_for(hist_root, root_g, root_h, root_c, True)  # root: depth 0
+    root_pen = cegb_gain_penalty(cegb, root_c, sample_mask)
+    best0 = best_for(hist_root, root_g, root_h, root_c, True,
+                     gain_penalty=root_pen)  # root: depth 0
     best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
 
     hist_pool = jnp.zeros((l, ncols, b, 3), jnp.float32)
@@ -318,12 +383,70 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                        best=best, tree=tree,
                        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
                        leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
-                       part=part0)
+                       part=part0, cegb=cegb,
+                       force_aborted=jnp.asarray(False))
+
+    def forced_split_info(s: _GrowState, t: jnp.ndarray):
+        """Evaluate the step-t forced (leaf, feature, threshold) from the
+        leaf's pooled histogram — GatherInfoForThresholdNumerical
+        (feature_histogram.hpp:284-357). Returns (leaf, BestSplit, ok)."""
+        tq = jnp.minimum(t, params.num_forced - 1)
+        fleaf = forced.leaf[tq]
+        ff = forced.feature[tq]
+        fthr = forced.threshold[tq]
+        ph_col = s.hist_pool[fleaf]                       # [C, B, 3]
+        # exact-enough leaf totals: every row lands in one bin of column 0
+        sum_g = jnp.sum(ph_col[0, :, 0])
+        sum_h = jnp.sum(ph_col[0, :, 1])
+        cnt = jnp.sum(ph_col[0, :, 2])
+        row = expand(ph_col, sum_g, sum_h, cnt)[ff]       # [Bf, 3]
+        nb = meta.num_bin[ff]
+        db = meta.default_bin[ff]
+        mt = meta.missing_type[ff]
+        bidx = jnp.arange(row.shape[0], dtype=jnp.int32)
+        # right side accumulates bins > threshold; the default bin (Zero
+        # missing) and the NaN bin fall left by subtraction, exactly like
+        # the reference's skip_default_bin / use_na_as_missing loop
+        in_right = (bidx > fthr) & (bidx < nb) \
+            & ~((mt == MISSING_ZERO) & (bidx == db)) \
+            & ~((mt == MISSING_NAN) & (bidx == nb - 1))
+        r = jnp.sum(row * in_right[:, None].astype(row.dtype), axis=0)
+        rg, rh, rc = r[0], r[1] + K_EPSILON, r[2]
+        lg, lh, lc = sum_g - rg, sum_h - rh, cnt - rc
+        shift = leaf_split_gain(sum_g, sum_h, sp.lambda_l1, sp.lambda_l2,
+                                sp.max_delta_step) + sp.min_gain_to_split
+        gain = leaf_split_gain(lg, lh, sp.lambda_l1, sp.lambda_l2,
+                               sp.max_delta_step) \
+            + leaf_split_gain(rg, rh, sp.lambda_l1, sp.lambda_l2,
+                              sp.max_delta_step) - shift
+        ok = (gain > 0.0) & (lc > 0) & (rc > 0)
+        bs = BestSplit(
+            gain=jnp.maximum(gain, 1e-30), feature=ff, threshold=fthr,
+            default_left=jnp.asarray(True),
+            left_sum_grad=lg, left_sum_hess=lh, left_count=lc,
+            right_sum_grad=rg, right_sum_hess=rh, right_count=rc,
+            left_output=calculate_leaf_output(
+                lg, lh, sp.lambda_l1, sp.lambda_l2, sp.max_delta_step),
+            right_output=calculate_leaf_output(
+                rg, rh, sp.lambda_l1, sp.lambda_l2, sp.max_delta_step),
+            is_categorical=jnp.asarray(False),
+            cat_bitset=jnp.zeros((8,), jnp.uint32))
+        return fleaf, bs, ok
 
     def step(t: jnp.ndarray, s: _GrowState) -> _GrowState:
         tree = s.tree
         leaf = jnp.argmax(s.best.gain).astype(jnp.int32)
         cur = jax.tree.map(lambda a: a[leaf], s.best)
+        force_aborted = s.force_aborted
+        if params.num_forced > 0 and forced is not None:
+            fleaf, fcur, fok = forced_split_info(s, t)
+            in_phase = (t < params.num_forced) & ~s.force_aborted
+            use_forced = in_phase & fok
+            force_aborted = s.force_aborted | (in_phase & ~fok)
+            leaf = jnp.where(use_forced, fleaf, leaf)
+            cur = jax.tree.map(
+                lambda fv, bv: jnp.where(use_forced, fv, bv), fcur,
+                jax.tree.map(lambda a: a[leaf], s.best))
         valid = cur.gain > 0.0  # reference breaks on gain <= 0 (:217-219)
 
         # ---- partition rows of `leaf` (DataPartition::Split analog) ------
@@ -476,11 +599,36 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_max = _masked_set(_masked_set(s.leaf_max, leaf, l_max, valid),
                                right_leaf, r_max, valid)
 
+        # ---- CEGB acquisition-state update (Split, :757, :766-774) -------
+        cegb_state = s.cegb
+        if cegb_state is not None:
+            fu = jnp.where(valid,
+                           cegb_state.feature_used.at[cur.feature].set(True),
+                           cegb_state.feature_used)
+            ru = cegb_state.row_used
+            if params.with_cegb_lazy:
+                # only bagged rows pay (the reference marks the rows in the
+                # data partition, which holds the bagging subset, :766-774)
+                in_split = ((leaf_id == leaf) | (leaf_id == right_leaf)) \
+                    & valid & (sample_mask > 0)
+                ru = ru.at[cur.feature].max(in_split.astype(ru.dtype))
+            cegb_state = cegb_state._replace(feature_used=fu, row_used=ru)
+
         def child_bests(_):
+            lp = rp = None
+            if cegb_state is not None:
+                lp = cegb_gain_penalty(cegb_state, cur.left_count,
+                                       (leaf_id == leaf)
+                                       .astype(jnp.float32) * sample_mask)
+                rp = cegb_gain_penalty(cegb_state, cur.right_count,
+                                       (leaf_id == right_leaf)
+                                       .astype(jnp.float32) * sample_mask)
             bl = best_for(hist_left, cur.left_sum_grad, cur.left_sum_hess,
-                          cur.left_count, depth_ok, l_min, l_max)
+                          cur.left_count, depth_ok, l_min, l_max,
+                          gain_penalty=lp)
             br = best_for(hist_right, cur.right_sum_grad, cur.right_sum_hess,
-                          cur.right_count, depth_ok, r_min, r_max)
+                          cur.right_count, depth_ok, r_min, r_max,
+                          gain_penalty=rp)
             return bl, br
 
         def dead_bests(_):
@@ -501,7 +649,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         return _GrowState(leaf_id=leaf_id, hist_pool=hist_pool,
                           best=best, tree=tree,
-                          leaf_min=leaf_min, leaf_max=leaf_max, part=part)
+                          leaf_min=leaf_min, leaf_max=leaf_max, part=part,
+                          cegb=cegb_state, force_aborted=force_aborted)
 
     state = lax.fori_loop(0, l - 1, step, state)
-    return state.tree, state.leaf_id
+    return state.tree, state.leaf_id, state.cegb

@@ -478,13 +478,23 @@ def find_best_split(
         min_constraint: float | jnp.ndarray = -jnp.inf,
         max_constraint: float | jnp.ndarray = jnp.inf,
         with_categorical: bool = False,
+        gain_penalty: jnp.ndarray | None = None,
 ) -> BestSplit:
     """Best split over all features, numerical and (when the dataset has any)
     categorical — the per-leaf SplitInfo argmax
-    (serial_tree_learner.cpp:506-591)."""
+    (serial_tree_learner.cpp:506-591).
+
+    ``gain_penalty`` [F] is subtracted from each feature's best gain before
+    the argmax — the CEGB cost model (serial_tree_learner.cpp:533-539):
+    penalized gains both rank candidates and become the recorded split gain,
+    exactly as the reference mutates SplitInfo::gain in place.
+    """
     pf, bitsets = per_feature_split_merged(
         hist, meta, params, sum_grad, sum_hess, num_data, feature_mask,
         min_constraint, max_constraint, with_categorical)
+    if gain_penalty is not None:
+        pf = pf._replace(gain=jnp.where(jnp.isfinite(pf.gain),
+                                        pf.gain - gain_penalty, pf.gain))
     best_f = jnp.argmax(pf.gain).astype(jnp.int32)
     sel = lambda a: a[best_f]
     gain = pf.gain[best_f]

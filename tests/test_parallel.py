@@ -157,12 +157,12 @@ def test_grow_tree_explicit_psum_path():
 
     tree_ref, leaf_ref = jax.jit(
         lambda xbj, gj, hj, mj: grow_tree(xbj, gj, hj, mj, meta, fmask,
-                                          params))(xb, g, h, ones)
+                                          params)[:2])(xb, g, h, ones)
 
     mesh = Mesh(np.asarray(jax.devices()), ("data",))
     fn = shard_map(
         lambda xbj, gj, hj, mj: grow_tree(xbj, gj, hj, mj, meta, fmask,
-                                          params, axis_name="data"),
+                                          params, axis_name="data")[:2],
         mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P("data")),
         out_specs=(jax.tree.map(lambda _: P(), tree_ref), P("data")))
