@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ..config import Config
@@ -252,6 +253,21 @@ class GBDT:
 
         self._forced_splits, num_forced = self._setup_forced_splits()
         self._cegb_state = self._setup_cegb()
+        # histogram pool cap (histogram_pool_size MB, config.h; the
+        # HistogramPool LRU of feature_histogram.hpp:646-820). -1 = one
+        # slot per leaf.
+        pool_slots = 0
+        # mesh modes keep the full pool: the rebuild-on-miss cond cannot
+        # hold the psum a sharded rebuild needs (same SPMD constraint the
+        # growth loop documents for its dead-iteration histograms)
+        if cfg.histogram_pool_size > 0 and cfg.tree_learner != "voting" \
+                and self.mesh is None:
+            bytes_per_hist = xb_np.shape[1] * self.num_bins * 3 * 4
+            pool_slots = int(cfg.histogram_pool_size * 1024 * 1024
+                             // max(bytes_per_hist, 1))
+            pool_slots = max(2, min(cfg.num_leaves, pool_slots))
+            if pool_slots >= cfg.num_leaves:
+                pool_slots = 0  # cap larger than the full pool: uncapped
         if cfg.tree_learner == "voting" and self.mesh is not None and \
                 (num_forced > 0 or self._cegb_state is not None):
             raise LightGBMError("forced splits / CEGB are not supported "
@@ -262,6 +278,7 @@ class GBDT:
             num_bins=self.num_bins,
             max_depth=cfg.max_depth,
             num_forced=num_forced,
+            pool_slots=pool_slots,
             cegb_split_penalty=float(cfg.cegb_tradeoff
                                      * cfg.cegb_penalty_split),
             with_cegb_coupled=bool(len(cfg.cegb_penalty_feature_coupled)),
@@ -550,8 +567,24 @@ class GBDT:
                                      feature_mask, params,
                                      forced=forced_splits, cegb=cs)
 
-            trees, leaf_ids, cegb_out = jax.vmap(
-                grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
+            # class batching: vmap would turn the capped pool's
+            # rebuild-on-miss lax.cond into a both-branches select, paying
+            # a full rebuild every step — so k == 1 calls directly and a
+            # capped multiclass run maps classes sequentially (which also
+            # keeps one pool's worth of live memory, the point of the cap)
+            if k == 1:
+                t1, li1, cb1 = grow_one(g[:, 0], h[:, 0], cegb_state)
+                trees = jax.tree.map(lambda a: a[None], t1)
+                leaf_ids = li1[None]
+                cegb_out = (jax.tree.map(lambda a: a[None], cb1)
+                            if cb1 is not None else None)
+            elif params.pool_slots > 0:
+                trees, leaf_ids, cegb_out = lax.map(
+                    lambda gh: grow_one(gh[0], gh[1], cegb_state),
+                    (g.T, h.T))
+            else:
+                trees, leaf_ids, cegb_out = jax.vmap(
+                    grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
             if cegb_state is not None:
                 # classes train from the iteration-start state; acquisitions
                 # merge across class trees for the next iteration (the

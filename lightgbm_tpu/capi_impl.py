@@ -1,0 +1,247 @@
+"""Python side of the C ABI (native/src/c_api.cpp).
+
+The embedded interpreter calls these flat functions with primitive
+arguments (memoryviews over caller-owned buffers, strings, ints) and gets
+primitives/bytes back, keeping the C++ shim free of object-protocol
+details. The reference implements the same surface natively
+(src/c_api.cpp:46-363 Booster wrapper + the LGBM_* bodies); here the
+runtime IS the Python package, so the ABI marshals into it.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+# honor the host's JAX_PLATFORMS choice BEFORE any backend init: site
+# hooks may overwrite the env var, but jax.config wins over both
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .log import LightGBMError
+
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def parse_params(s: Optional[str]) -> Dict[str, str]:
+    """"k1=v1 k2=v2" -> dict (Config::KV2Map semantics, config.cpp)."""
+    out: Dict[str, str] = {}
+    for tok in (s or "").replace("\t", " ").split(" "):
+        tok = tok.strip()
+        if not tok or tok.startswith("#"):
+            continue
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+        else:
+            out[tok] = "true"
+    return out
+
+
+def _mat(mv: memoryview, dtype_code: int, nrow: int, ncol: int,
+         row_major: int) -> np.ndarray:
+    dt = _DTYPES[dtype_code]
+    arr = np.frombuffer(mv, dtype=dt, count=nrow * ncol)
+    if row_major:
+        return arr.reshape(nrow, ncol)
+    return arr.reshape(ncol, nrow).T
+
+
+def dataset_from_file(filename: str, params: str,
+                      reference: Optional[Dataset]) -> Dataset:
+    p = parse_params(params)
+    label_kw = {}
+    ds = Dataset(filename, reference=reference, params=p,
+                 free_raw_data=False, **label_kw)
+    ds.construct()
+    return ds
+
+
+def dataset_from_mat(mv: memoryview, dtype_code: int, nrow: int, ncol: int,
+                     row_major: int, params: str,
+                     reference: Optional[Dataset]) -> Dataset:
+    # the C contract lets the host free its buffer as soon as the call
+    # returns; copy=True guards against astype's no-op fast path handing
+    # back a view of caller memory
+    data = _mat(mv, dtype_code, nrow, ncol, row_major) \
+        .astype(np.float64, copy=True)
+    ds = Dataset(data, reference=reference, params=parse_params(params),
+                 free_raw_data=False)
+    return ds
+
+
+def dataset_from_csr(indptr_mv: memoryview, indptr_code: int,
+                     indices_mv: memoryview, data_mv: memoryview,
+                     data_code: int, nindptr: int, nelem: int,
+                     num_col: int, params: str,
+                     reference: Optional[Dataset]) -> Dataset:
+    from scipy.sparse import csr_matrix
+    # copy out of caller-owned memory (the host may free it on return)
+    indptr = np.frombuffer(indptr_mv, dtype=_DTYPES[indptr_code],
+                           count=nindptr).copy()
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem).copy()
+    vals = np.frombuffer(data_mv, dtype=_DTYPES[data_code],
+                         count=nelem).copy()
+    mat = csr_matrix((vals, indices, indptr),
+                     shape=(nindptr - 1, num_col))
+    return Dataset(mat, reference=reference, params=parse_params(params),
+                   free_raw_data=False)
+
+
+def dataset_set_field(ds: Dataset, name: str, mv: Optional[memoryview],
+                      num_element: int, dtype_code: int) -> None:
+    if mv is None or num_element == 0:
+        ds.set_field(name, None)
+        return
+    arr = np.frombuffer(mv, dtype=_DTYPES[dtype_code], count=num_element)
+    ds.set_field(name, np.array(arr))
+
+
+def dataset_num_data(ds: Dataset) -> int:
+    return int(ds.construct().num_data())
+
+
+def dataset_num_feature(ds: Dataset) -> int:
+    return int(ds.construct().num_feature())
+
+
+def dataset_set_feature_names(ds: Dataset, names: List[str]) -> None:
+    ds.feature_name = list(names)
+
+
+def booster_create(train: Dataset, params: str) -> Booster:
+    return Booster(params=parse_params(params), train_set=train)
+
+
+def booster_from_file(filename: str) -> Tuple[Booster, int]:
+    bst = Booster(model_file=filename)
+    return bst, bst.current_iteration
+
+
+def booster_from_string(model_str: str) -> Tuple[Booster, int]:
+    bst = Booster(model_str=model_str)
+    return bst, bst.current_iteration
+
+
+def booster_add_valid(bst: Booster, valid: Dataset) -> None:
+    bst.add_valid(valid, "valid_%d" % (len(bst._valid_sets) + 1))
+
+
+def booster_update(bst: Booster) -> int:
+    return int(bool(bst.update()))
+
+
+def booster_update_custom(bst: Booster, grad_mv: memoryview,
+                          hess_mv: memoryview, n: int) -> int:
+    grad = np.frombuffer(grad_mv, dtype=np.float32, count=n)
+    hess = np.frombuffer(hess_mv, dtype=np.float32, count=n)
+    return int(bool(bst._impl.train_one_iter(np.array(grad),
+                                             np.array(hess))))
+
+
+def booster_num_classes(bst: Booster) -> int:
+    return int(bst._impl.num_class)
+
+
+def booster_num_train_rows_times_classes(bst: Booster) -> int:
+    impl = bst._impl
+    return int(impl.num_data * impl.num_tree_per_iteration)
+
+
+def booster_rollback(bst: Booster) -> None:
+    bst.rollback_one_iter()
+
+
+def booster_current_iteration(bst: Booster) -> int:
+    return int(bst.current_iteration)
+
+
+def booster_num_model_per_iteration(bst: Booster) -> int:
+    return int(bst.num_model_per_iteration())
+
+
+def booster_num_total_model(bst: Booster) -> int:
+    return int(bst.num_trees())
+
+
+def booster_eval(bst: Booster, data_idx: int) -> bytes:
+    if data_idx == 0:
+        res = bst.eval_train()
+    else:
+        res = [r for r in bst.eval_valid()
+               if r[0] == ("valid_%d" % data_idx)]
+    return np.asarray([v for _, _, v, _ in res], np.float64).tobytes()
+
+
+def booster_eval_names(bst: Booster) -> List[str]:
+    names = []
+    for m in bst._impl.train_metrics:
+        names.extend(m.names)
+    return names
+
+
+def booster_predict_mat(bst: Booster, mv: memoryview, dtype_code: int,
+                        nrow: int, ncol: int, row_major: int,
+                        predict_type: int, num_iteration: int,
+                        parameter: str) -> bytes:
+    data = _mat(mv, dtype_code, nrow, ncol, row_major)
+    p = parse_params(parameter)
+    kw = dict(num_iteration=(num_iteration if num_iteration > 0 else None))
+    if predict_type == 1:
+        kw["raw_score"] = True
+    elif predict_type == 2:
+        kw["pred_leaf"] = True
+    elif predict_type == 3:
+        kw["pred_contrib"] = True
+    if "pred_early_stop" in p:
+        kw["pred_early_stop"] = p["pred_early_stop"] in ("true", "1")
+    out = np.asarray(bst.predict(np.ascontiguousarray(data, np.float64),
+                                 **kw), np.float64)
+    return out.tobytes()
+
+
+def booster_save_model(bst: Booster, start_iteration: int,
+                       num_iteration: int, filename: str) -> None:
+    # C ABI: num_iteration <= 0 means "all" (not best_iteration)
+    bst.save_model(filename,
+                   num_iteration=(num_iteration if num_iteration > 0
+                                  else -1),
+                   start_iteration=max(start_iteration, 0))
+
+
+def booster_model_to_string(bst: Booster, start_iteration: int,
+                            num_iteration: int) -> str:
+    return bst.model_to_string(
+        num_iteration=(num_iteration if num_iteration > 0 else -1),
+        start_iteration=max(start_iteration, 0))
+
+
+def booster_dump_model(bst: Booster, start_iteration: int,
+                       num_iteration: int) -> str:
+    import json
+    return json.dumps(bst.dump_model(
+        num_iteration=(num_iteration if num_iteration > 0 else -1)))
+
+
+def booster_feature_importance(bst: Booster, num_iteration: int,
+                               importance_type: int) -> bytes:
+    kind = "gain" if importance_type == 1 else "split"
+    imp = bst.feature_importance(importance_type=kind,
+                                 iteration=(num_iteration
+                                            if num_iteration > 0 else None))
+    return np.asarray(imp, np.float64).tobytes()
+
+
+def network_init(machines: str, local_listen_port: int, listen_time_out: int,
+                 num_machines: int) -> None:
+    from .parallel import network
+    network.init(machines=machines, local_listen_port=local_listen_port,
+                 listen_time_out=listen_time_out, num_machines=num_machines)
+
+
+def network_free() -> None:
+    from .parallel import network
+    network.free()

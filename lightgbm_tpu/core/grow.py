@@ -78,6 +78,11 @@ class GrowParams(NamedTuple):
     cegb_split_penalty: float = 0.0
     with_cegb_coupled: bool = False
     with_cegb_lazy: bool = False
+    # histogram pool cap (HistogramPool, feature_histogram.hpp:646-820):
+    # 0 = one slot per leaf (unlimited); otherwise S < num_leaves slots with
+    # LRU eviction, rebuilding an evicted parent histogram from its rows
+    # when that leaf is finally chosen for splitting (the Move/Get dance)
+    pool_slots: int = 0
 
 
 class TreeArrays(NamedTuple):
@@ -161,9 +166,17 @@ class CegbState(NamedTuple):
     #                               [F, 0] when lazy penalties are off
 
 
+class PoolMap(NamedTuple):
+    """Slot bookkeeping for the capped histogram pool."""
+    slot_of_leaf: jnp.ndarray  # [L] int32, -1 = evicted / never built
+    leaf_of_slot: jnp.ndarray  # [S] int32, -1 = free
+    last_used: jnp.ndarray     # [S] int32 LRU stamp, -1 = free
+
+
 class _GrowState(NamedTuple):
     leaf_id: jnp.ndarray      # [N] int32
-    hist_pool: jnp.ndarray    # [L, F, B, 3] f32 per-leaf histograms
+    hist_pool: jnp.ndarray    # [S, F, B, 3] f32 histogram slots (S = L
+    #                           uncapped, or pool_slots under the LRU cap)
     best: BestSplit           # per-leaf best split, fields [L]
     tree: TreeArrays
     leaf_min: jnp.ndarray     # [L] f32 monotone lower output bound
@@ -173,6 +186,7 @@ class _GrowState(NamedTuple):
     force_aborted: jnp.ndarray    # scalar bool — a forced split failed;
     #                               remaining forced steps fall back to
     #                               best-first (aborted_last_force_split)
+    pool_map: Optional[PoolMap]   # LRU slot map (None = uncapped)
 
 
 def _empty_best(num_leaves: int) -> BestSplit:
@@ -367,11 +381,48 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                      gain_penalty=root_pen)  # root: depth 0
     best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
 
-    hist_pool = jnp.zeros((l, ncols, b, 3), jnp.float32)
+    capped = 0 < params.pool_slots < l
+    assert not (capped and axis_name is not None), \
+        "histogram_pool_size cap is not supported on sharded learners " \
+        "(rebuild-on-miss cannot psum under lax.cond)"
+    assert not capped or params.pool_slots >= 2, \
+        "a capped histogram pool needs at least 2 slots (both children " \
+        "of a split are resident)"
+    num_slots = params.pool_slots if capped else l
+    hist_pool = jnp.zeros((num_slots, ncols, b, 3), jnp.float32)
     if voting:
         # the pool holds LOCAL histograms in voting mode -> device-varying
         hist_pool = lax.pcast(hist_pool, (axis_name,), to="varying")
     hist_pool = hist_pool.at[0].set(hist_root)
+    pool_map0 = None
+    if capped:
+        pool_map0 = PoolMap(
+            slot_of_leaf=jnp.full((l,), -1, jnp.int32).at[0].set(0),
+            leaf_of_slot=jnp.full((num_slots,), -1, jnp.int32).at[0].set(0),
+            last_used=jnp.full((num_slots,), -1, jnp.int32).at[0].set(0))
+
+    def leaf_hist(s: _GrowState, leaf_idx, live=True):
+        """A leaf's [C, B, 3] histogram: the pool slot when resident, else
+        rebuilt from the leaf's rows (HistogramPool::Get miss path). Must
+        run BEFORE the step's partition update — the rebuild walks the
+        pre-split row partition / leaf_id."""
+        if not capped:
+            return s.hist_pool[leaf_idx]
+        sl = s.pool_map.slot_of_leaf[leaf_idx]
+
+        def read(_):
+            return s.hist_pool[jnp.maximum(sl, 0)]
+
+        def rebuild(_):
+            if use_partition:
+                return hist_for_leaf(s.part, leaf_idx, xb, grad, hess,
+                                     sample_mask, b, params.row_chunk,
+                                     valid=True, impl=params.hist_impl)
+            m = (s.leaf_id == leaf_idx).astype(jnp.float32) * sample_mask
+            return hist_for_mask(m)
+
+        # dead iterations (live=False) never pay for a rebuild
+        return lax.cond((sl < 0) & live, rebuild, read, operand=None)
 
     leaf_id0 = jnp.zeros((n,), jnp.int32)
     if axis_name is not None:
@@ -384,9 +435,10 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
                        leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
                        part=part0, cegb=cegb,
-                       force_aborted=jnp.asarray(False))
+                       force_aborted=jnp.asarray(False),
+                       pool_map=pool_map0)
 
-    def forced_split_info(s: _GrowState, t: jnp.ndarray):
+    def forced_split_info(s: _GrowState, t: jnp.ndarray, in_phase):
         """Evaluate the step-t forced (leaf, feature, threshold) from the
         leaf's pooled histogram — GatherInfoForThresholdNumerical
         (feature_histogram.hpp:284-357). Returns (leaf, BestSplit, ok)."""
@@ -394,7 +446,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         fleaf = forced.leaf[tq]
         ff = forced.feature[tq]
         fthr = forced.threshold[tq]
-        ph_col = s.hist_pool[fleaf]                       # [C, B, 3]
+        # steps past the forced phase discard this whole evaluation;
+        # live=False keeps them from paying a pool-miss rebuild
+        ph_col = leaf_hist(s, fleaf, live=in_phase)       # [C, B, 3]
         # exact-enough leaf totals: every row lands in one bin of column 0
         sum_g = jnp.sum(ph_col[0, :, 0])
         sum_h = jnp.sum(ph_col[0, :, 1])
@@ -439,8 +493,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cur = jax.tree.map(lambda a: a[leaf], s.best)
         force_aborted = s.force_aborted
         if params.num_forced > 0 and forced is not None:
-            fleaf, fcur, fok = forced_split_info(s, t)
             in_phase = (t < params.num_forced) & ~s.force_aborted
+            fleaf, fcur, fok = forced_split_info(s, t, in_phase)
             use_forced = in_phase & fok
             force_aborted = s.force_aborted | (in_phase & ~fok)
             leaf = jnp.where(use_forced, fleaf, leaf)
@@ -572,11 +626,46 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             hist_small = hist_for_mask(
                 (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
                 * valid.astype(jnp.float32))
-        hist_large = s.hist_pool[leaf] - hist_small
-        hist_pool = s.hist_pool.at[small_leaf].set(
-            jnp.where(valid, hist_small, s.hist_pool[small_leaf]))
-        hist_pool = hist_pool.at[large_leaf].set(
-            jnp.where(valid, hist_large, hist_pool[large_leaf]))
+        hist_parent = leaf_hist(s, leaf, live=valid)
+        hist_large = hist_parent - hist_small
+        if not capped:
+            pool_map = s.pool_map
+            hist_pool = s.hist_pool.at[small_leaf].set(
+                jnp.where(valid, hist_small, s.hist_pool[small_leaf]))
+            hist_pool = hist_pool.at[large_leaf].set(
+                jnp.where(valid, hist_large, hist_pool[large_leaf]))
+        else:
+            # LRU slot allocation (HistogramPool::Move/Get): the larger
+            # child reuses the parent's slot when resident; the smaller
+            # child takes the least-recently-used other slot. Evicted
+            # occupants rebuild from rows if ever chosen for splitting.
+            pm = s.pool_map
+            big = jnp.int32(2 ** 30)
+            sl_parent = pm.slot_of_leaf[leaf]
+            lru1 = jnp.argmin(pm.last_used).astype(jnp.int32)
+            target_large = jnp.where(sl_parent >= 0, sl_parent, lru1)
+            target_small = jnp.argmin(
+                pm.last_used.at[target_large].set(big)).astype(jnp.int32)
+            sol = pm.slot_of_leaf
+            for prev in (pm.leaf_of_slot[target_large],
+                         pm.leaf_of_slot[target_small]):
+                sol = sol.at[jnp.maximum(prev, 0)].set(
+                    jnp.where(valid & (prev >= 0), -1,
+                              sol[jnp.maximum(prev, 0)]))
+            sol = _masked_set(sol, large_leaf, target_large, valid)
+            sol = _masked_set(sol, small_leaf, target_small, valid)
+            los = _masked_set(pm.leaf_of_slot, target_large, large_leaf,
+                              valid)
+            los = _masked_set(los, target_small, small_leaf, valid)
+            stamp = (t + 1).astype(jnp.int32)
+            lu = _masked_set(pm.last_used, target_large, stamp, valid)
+            lu = _masked_set(lu, target_small, stamp, valid)
+            pool_map = PoolMap(slot_of_leaf=sol, leaf_of_slot=los,
+                               last_used=lu)
+            hist_pool = s.hist_pool.at[target_large].set(
+                jnp.where(valid, hist_large, s.hist_pool[target_large]))
+            hist_pool = hist_pool.at[target_small].set(
+                jnp.where(valid, hist_small, hist_pool[target_small]))
 
         # ---- best splits for the two children ----------------------------
         depth_ok = (params.max_depth <= 0) | (depth < params.max_depth)
@@ -650,7 +739,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return _GrowState(leaf_id=leaf_id, hist_pool=hist_pool,
                           best=best, tree=tree,
                           leaf_min=leaf_min, leaf_max=leaf_max, part=part,
-                          cegb=cegb_state, force_aborted=force_aborted)
+                          cegb=cegb_state, force_aborted=force_aborted,
+                          pool_map=pool_map)
 
     state = lax.fori_loop(0, l - 1, step, state)
     return state.tree, state.leaf_id, state.cegb

@@ -1,0 +1,599 @@
+// C ABI for lightgbm_tpu (header: ../include/lightgbm_tpu_c_api.h).
+//
+// The reference implements this surface directly against its C++ core
+// (src/c_api.cpp Booster wrapper). Here the core runtime is the
+// lightgbm_tpu Python package (JAX/XLA on TPU), so this translation unit
+// embeds a CPython interpreter and marshals: C buffers cross the boundary
+// as memoryviews (zero-copy in; the Python side copies what it keeps),
+// results come back as bytes/str and are memcpy'd into caller storage.
+// Every entry point grabs the GIL, so the library is safe both embedded
+// in a plain C host and loaded via ctypes inside an existing interpreter.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/lightgbm_tpu_c_api.h"
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+
+PyObject* g_impl_module = nullptr;  // lightgbm_tpu.capi_impl
+std::once_flag g_init_flag;
+bool g_we_initialized = false;
+
+void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+}
+
+void boot_interpreter() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+#if PY_VERSION_HEX < 0x03090000
+    PyEval_InitThreads();
+#endif
+    // the embedding host owns the thread; release the GIL so per-call
+    // PyGILState_Ensure works uniformly
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  // make the package importable: LIGHTGBM_TPU_PYROOT overrides, else cwd
+  const char* root = std::getenv("LIGHTGBM_TPU_PYROOT");
+  std::string code = "import sys, os\n";
+  if (root != nullptr) {
+    code += std::string("sys.path.insert(0, r'''") + root + "''')\n";
+  }
+  code += "sys.path.insert(0, os.getcwd())\n";
+  PyRun_SimpleString(code.c_str());
+  g_impl_module = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+  if (g_impl_module == nullptr) capture_py_error();
+  PyGILState_Release(st);
+}
+
+// RAII GIL + module bootstrap for every ABI call.
+class Gil {
+ public:
+  Gil() {
+    std::call_once(g_init_flag, boot_interpreter);
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+  bool ready() const { return g_impl_module != nullptr; }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// Call lightgbm_tpu.capi_impl.<fn>(args...); returns new reference or
+// nullptr (error already captured).
+PyObject* call_impl(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_impl_module, fn);
+  if (f == nullptr) {
+    capture_py_error();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) capture_py_error();
+  return r;
+}
+
+PyObject* mv_from(const void* p, Py_ssize_t nbytes) {
+  if (p == nullptr || nbytes == 0) Py_RETURN_NONE;
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<void*>(p)), nbytes, PyBUF_READ);
+}
+
+Py_ssize_t dtype_size(int code) {
+  switch (code) {
+    case C_API_DTYPE_FLOAT32: return 4;
+    case C_API_DTYPE_FLOAT64: return 8;
+    case C_API_DTYPE_INT32: return 4;
+    case C_API_DTYPE_INT64: return 8;
+    default: return 0;
+  }
+}
+
+int copy_bytes_out(PyObject* bytes_obj, double* out, int64_t* out_len) {
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes_obj, &buf, &n) != 0) {
+    capture_py_error();
+    return -1;
+  }
+  std::memcpy(out, buf, static_cast<size_t>(n));
+  *out_len = static_cast<int64_t>(n / 8);
+  return 0;
+}
+
+int copy_str_out(PyObject* str_obj, int64_t buffer_len, int64_t* out_len,
+                 char* out_str) {
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(str_obj, &n);
+  if (s == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  *out_len = static_cast<int64_t>(n) + 1;
+  if (out_str != nullptr && buffer_len >= *out_len) {
+    std::memcpy(out_str, s, static_cast<size_t>(n) + 1);
+  }
+  return 0;
+}
+
+#define API_BEGIN()                                       \
+  Gil gil;                                                \
+  if (!gil.ready()) return -1;                            \
+  try {
+
+#define API_END()                                         \
+  } catch (const std::exception& e) {                     \
+    g_last_error = e.what();                              \
+    return -1;                                            \
+  }                                                       \
+  return 0;
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+/* ------------------------------------------------------------ Dataset */
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference)
+                            : Py_None;
+  PyObject* r = call_impl("dataset_from_file",
+                          Py_BuildValue("(ssO)", filename,
+                                        parameters ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;  // ownership transferred to the handle
+  API_END();
+}
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  API_BEGIN();
+  Py_ssize_t nbytes =
+      static_cast<Py_ssize_t>(nrow) * ncol * dtype_size(data_type);
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference)
+                            : Py_None;
+  PyObject* r = call_impl(
+      "dataset_from_mat",
+      Py_BuildValue("(NiiiisO)", mv_from(data, nbytes), data_type,
+                    static_cast<int>(nrow), static_cast<int>(ncol),
+                    is_row_major, parameters ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference)
+                            : Py_None;
+  PyObject* r = call_impl(
+      "dataset_from_csr",
+      Py_BuildValue("(NiNNiLLLsO)",
+                    mv_from(indptr, nindptr * dtype_size(indptr_type)),
+                    indptr_type,
+                    mv_from(indices, nelem * 4),
+                    mv_from(data, nelem * dtype_size(data_type)), data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    parameters ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  API_END();
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_set_field",
+      Py_BuildValue("(OsNii)", reinterpret_cast<PyObject*>(handle),
+                    field_name,
+                    mv_from(field_data, num_element * dtype_size(type)),
+                    num_element, type));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_num_data",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_num_feature",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names, int num_names) {
+  API_BEGIN();
+  PyObject* lst = PyList_New(num_names);
+  for (int i = 0; i < num_names; ++i) {
+    PyList_SetItem(lst, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* r = call_impl(
+      "dataset_set_feature_names",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(handle), lst));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ------------------------------------------------------------ Booster */
+
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters, BoosterHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_create",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(train_data),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+static int booster_from(const char* fn, const char* arg,
+                        int* out_num_iterations, BoosterHandle* out) {
+  PyObject* r = call_impl(fn, Py_BuildValue("(s)", arg));
+  if (r == nullptr) return -1;
+  PyObject* bst = PyTuple_GetItem(r, 0);
+  PyObject* it = PyTuple_GetItem(r, 1);
+  if (out_num_iterations != nullptr) {
+    *out_num_iterations = static_cast<int>(PyLong_AsLong(it));
+  }
+  Py_INCREF(bst);
+  *out = bst;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  API_BEGIN();
+  if (booster_from("booster_from_file", filename, out_num_iterations, out))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  API_BEGIN();
+  if (booster_from("booster_from_string", model_str, out_num_iterations,
+                   out))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  API_END();
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_add_valid",
+      Py_BuildValue("(OO)", reinterpret_cast<PyObject*>(handle),
+                    reinterpret_cast<PyObject*>(valid_data)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+static int int_attr_call(const char* fn, BoosterHandle handle, int* out) {
+  PyObject* r = call_impl(
+      fn, Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  API_BEGIN();
+  if (int_attr_call("booster_num_classes", handle, out_len)) return -1;
+  API_END();
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  API_BEGIN();
+  if (int_attr_call("booster_update", handle, is_finished)) return -1;
+  API_END();
+}
+
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished) {
+  API_BEGIN();
+  int n = 0;
+  if (int_attr_call("booster_num_train_rows_times_classes", handle, &n))
+    return -1;
+  PyObject* r = call_impl(
+      "booster_update_custom",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(grad, static_cast<Py_ssize_t>(n) * 4),
+                    mv_from(hess, static_cast<Py_ssize_t>(n) * 4), n));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_rollback",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                    int* out_iteration) {
+  API_BEGIN();
+  if (int_attr_call("booster_current_iteration", handle, out_iteration))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration) {
+  API_BEGIN();
+  if (int_attr_call("booster_num_model_per_iteration", handle,
+                    out_tree_per_iteration))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models) {
+  API_BEGIN();
+  if (int_attr_call("booster_num_total_model", handle, out_models))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_eval_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyList_Size(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_eval_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    std::strcpy(out_strs[i], s ? s : "");  // NOLINT: ABI contract —
+    // caller pre-allocates 128-byte slots, like the reference
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_eval",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), data_idx));
+  if (r == nullptr) return -1;
+  int64_t n64 = 0;
+  int rc = copy_bytes_out(r, out_results, &n64);
+  *out_len = static_cast<int>(n64);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  Py_ssize_t nbytes =
+      static_cast<Py_ssize_t>(nrow) * ncol * dtype_size(data_type);
+  PyObject* r = call_impl(
+      "booster_predict_mat",
+      Py_BuildValue("(ONiiiiiis)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(data, nbytes), data_type,
+                    static_cast<int>(nrow), static_cast<int>(ncol),
+                    is_row_major, predict_type, num_iteration,
+                    parameter ? parameter : ""));
+  if (r == nullptr) return -1;
+  int rc = copy_bytes_out(r, out_result, out_len);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_save_model",
+      Py_BuildValue("(Oiis)", reinterpret_cast<PyObject*>(handle),
+                    start_iteration, num_iteration, filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+static int string_out_call(const char* fn, BoosterHandle handle,
+                           int start_iteration, int num_iteration,
+                           int64_t buffer_len, int64_t* out_len,
+                           char* out_str) {
+  PyObject* r = call_impl(
+      fn, Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                        start_iteration, num_iteration));
+  if (r == nullptr) return -1;
+  int rc = copy_str_out(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration, int64_t buffer_len,
+                                  int64_t* out_len, char* out_str) {
+  API_BEGIN();
+  if (string_out_call("booster_model_to_string", handle, start_iteration,
+                      num_iteration, buffer_len, out_len, out_str))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int64_t buffer_len,
+                          int64_t* out_len, char* out_str) {
+  API_BEGIN();
+  if (string_out_call("booster_dump_model", handle, start_iteration,
+                      num_iteration, buffer_len, out_len, out_str))
+    return -1;
+  API_END();
+}
+
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_feature_importance",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                    num_iteration, importance_type));
+  if (r == nullptr) return -1;
+  int64_t n = 0;
+  int rc = copy_bytes_out(r, out_results, &n);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
+/* ------------------------------------------------------------ Network */
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "network_init",
+      Py_BuildValue("(siii)", machines ? machines : "", local_listen_port,
+                    listen_time_out, num_machines));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_NetworkFree() {
+  API_BEGIN();
+  PyObject* r = call_impl("network_free", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* Explicit not-supported surface: these reference entry points have no
+ * analog in this runtime (datasets bin on device in one shot; the
+ * collective backend is XLA over ICI/DCN, not injectable socket
+ * functions). They fail loudly instead of linking away. */
+static int not_supported(const char* what) {
+  g_last_error = std::string(what) +
+      " is not supported by lightgbm_tpu (see native/BINDINGS.md)";
+  return -1;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle, const void*, int, int32_t, int32_t,
+                         int32_t) {
+  return not_supported("LGBM_DatasetPushRows");
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle, const void*, int,
+                              const int32_t*, const void*, int, int64_t,
+                              int64_t, int64_t, int64_t) {
+  return not_supported("LGBM_DatasetPushRowsByCSR");
+}
+
+int LGBM_DatasetCreateFromCSC(const void*, int, const int32_t*, const void*,
+                              int, int64_t, int64_t, int64_t, const char*,
+                              const DatasetHandle, DatasetHandle*) {
+  return not_supported("LGBM_DatasetCreateFromCSC");
+}
+
+int LGBM_NetworkInitWithFunctions(int, int, void*, void*) {
+  return not_supported("LGBM_NetworkInitWithFunctions");
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+/* End-to-end C ABI smoke: dataset from a dense matrix, train, evaluate,
+ * predict, save/load roundtrip — a C host driving the TPU runtime through
+ * lib_lightgbm.so. Compiled and run by tests/test_capi.py. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../native/include/lightgbm_tpu_c_api.h"
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAIL %s: %s\n", #call, LGBM_GetLastError());   \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(void) {
+  const int n = 2000, f = 5;
+  double* X = (double*)malloc(sizeof(double) * n * f);
+  float* y = (float*)malloc(sizeof(float) * n);
+  unsigned s = 42;
+  for (int i = 0; i < n; ++i) {
+    double acc = 0;
+    for (int j = 0; j < f; ++j) {
+      s = s * 1664525u + 1013904223u;
+      double v = (double)(s >> 8) / (double)(1u << 24) - 0.5;
+      X[i * f + j] = v;
+      if (j < 2) acc += v;
+    }
+    y[i] = acc > 0 ? 1.0f : 0.0f;
+  }
+
+  DatasetHandle ds = NULL;
+  CHECK(LGBM_DatasetCreateFromMat(X, C_API_DTYPE_FLOAT64, n, f, 1,
+                                  "max_bin=255", NULL, &ds));
+  CHECK(LGBM_DatasetSetField(ds, "label", y, n, C_API_DTYPE_FLOAT32));
+
+  int32_t nd = 0, nf = 0;
+  CHECK(LGBM_DatasetGetNumData(ds, &nd));
+  CHECK(LGBM_DatasetGetNumFeature(ds, &nf));
+  if (nd != n || nf != f) {
+    fprintf(stderr, "FAIL dims: %d %d\n", nd, nf);
+    return 1;
+  }
+
+  BoosterHandle bst = NULL;
+  CHECK(LGBM_BoosterCreate(
+      ds, "objective=binary metric=auc num_leaves=15 verbosity=-1", &bst));
+  int finished = 0;
+  for (int it = 0; it < 10 && !finished; ++it) {
+    CHECK(LGBM_BoosterUpdateOneIter(bst, &finished));
+  }
+  int cur = 0;
+  CHECK(LGBM_BoosterGetCurrentIteration(bst, &cur));
+  if (cur < 5) {
+    fprintf(stderr, "FAIL too few iterations: %d\n", cur);
+    return 1;
+  }
+
+  int eval_len = 0;
+  double evals[16];
+  CHECK(LGBM_BoosterGetEval(bst, 0, &eval_len, evals));
+  if (eval_len < 1 || evals[0] < 0.9) {
+    fprintf(stderr, "FAIL auc: len=%d v=%f\n", eval_len,
+            eval_len ? evals[0] : -1);
+    return 1;
+  }
+
+  int64_t pred_len = 0;
+  double* preds = (double*)malloc(sizeof(double) * n);
+  CHECK(LGBM_BoosterPredictForMat(bst, X, C_API_DTYPE_FLOAT64, n, f, 1,
+                                  C_API_PREDICT_NORMAL, -1, "", &pred_len,
+                                  preds));
+  if (pred_len != n) {
+    fprintf(stderr, "FAIL pred_len: %lld\n", (long long)pred_len);
+    return 1;
+  }
+  int correct = 0;
+  for (int i = 0; i < n; ++i) {
+    correct += (preds[i] > 0.5) == (y[i] > 0.5f);
+  }
+  if (correct < n * 0.9) {
+    fprintf(stderr, "FAIL accuracy: %d/%d\n", correct, n);
+    return 1;
+  }
+
+  /* save -> load -> identical raw predictions */
+  int64_t mlen = 0;
+  CHECK(LGBM_BoosterSaveModelToString(bst, 0, -1, 0, &mlen, NULL));
+  char* mstr = (char*)malloc((size_t)mlen);
+  int64_t mlen2 = 0;
+  CHECK(LGBM_BoosterSaveModelToString(bst, 0, -1, mlen, &mlen2, mstr));
+  BoosterHandle bst2 = NULL;
+  int iters2 = 0;
+  CHECK(LGBM_BoosterLoadModelFromString(mstr, &iters2, &bst2));
+  double* preds2 = (double*)malloc(sizeof(double) * n);
+  int64_t pred_len2 = 0;
+  CHECK(LGBM_BoosterPredictForMat(bst2, X, C_API_DTYPE_FLOAT64, n, f, 1,
+                                  C_API_PREDICT_RAW_SCORE, -1, "",
+                                  &pred_len2, preds2));
+  CHECK(LGBM_BoosterPredictForMat(bst, X, C_API_DTYPE_FLOAT64, n, f, 1,
+                                  C_API_PREDICT_RAW_SCORE, -1, "",
+                                  &pred_len, preds));
+  for (int i = 0; i < n; ++i) {
+    if (preds[i] != preds2[i]) {
+      fprintf(stderr, "FAIL roundtrip mismatch at %d\n", i);
+      return 1;
+    }
+  }
+
+  CHECK(LGBM_BoosterFree(bst));
+  CHECK(LGBM_BoosterFree(bst2));
+  CHECK(LGBM_DatasetFree(ds));
+  printf("CAPI_SMOKE_OK iters=%d auc=%.4f acc=%d/%d\n", cur, evals[0],
+         correct, n);
+  return 0;
+}

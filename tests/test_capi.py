@@ -1,0 +1,103 @@
+"""C ABI (native/src/c_api.cpp) tests.
+
+Two load modes, both real:
+- a pure C host program (tests/capi_smoke.c) linking lib_lightgbm.so and
+  booting the embedded interpreter itself;
+- ctypes from inside this interpreter (the R/SWIG binding path).
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+LIB = os.path.join(NATIVE, "lib_lightgbm.so")
+
+
+def _build():
+    r = subprocess.run(["make", "-C", NATIVE, "lib_lightgbm.so"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+@pytest.fixture(scope="module")
+def lib_path():
+    _build()
+    return LIB
+
+
+def test_c_host_end_to_end(lib_path, tmp_path):
+    """Compile the C smoke program and run it as its own process."""
+    exe = str(tmp_path / "capi_smoke")
+    r = subprocess.run(
+        ["g++", os.path.join(REPO, "tests", "capi_smoke.c"),
+         "-o", exe, "-L" + NATIVE, "-l_lightgbm",
+         "-Wl,-rpath," + NATIVE],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ, LIGHTGBM_TPU_PYROOT=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=560,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "CAPI_SMOKE_OK" in r.stdout
+
+
+def test_ctypes_in_process(lib_path):
+    """Load the ABI into this interpreter (how R's .Call glue would)."""
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 500, 4, 1, b"max_bin=63",
+        None, ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_GetLastError()
+    rc = lib.LGBM_DatasetSetField(ds, b"label",
+                                  y.ctypes.data_as(ctypes.c_void_p), 500, 0)
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    bst = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst))
+    assert rc == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(5):
+        rc = lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin))
+        assert rc == 0, lib.LGBM_GetLastError()
+
+    out_len = ctypes.c_int64(0)
+    preds = np.zeros(500, np.float64)
+    rc = lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, 500, 4, 1, 0, -1, b"",
+        ctypes.byref(out_len), preds.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == 500
+    acc = np.mean((preds > 0.5) == (y > 0.5))
+    assert acc > 0.9, acc
+
+    nclass = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetNumClasses(bst, ctypes.byref(nclass)) == 0
+    assert nclass.value == 1
+    assert lib.LGBM_BoosterFree(bst) == 0
+    assert lib.LGBM_DatasetFree(ds) == 0
+
+
+def test_error_reporting(lib_path):
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    out = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        b"/nonexistent/model.txt", ctypes.byref(ctypes.c_int(0)),
+        ctypes.byref(out))
+    assert rc == -1
+    assert b"" != lib.LGBM_GetLastError()

@@ -1,0 +1,62 @@
+"""Capped histogram pool (histogram_pool_size): LRU slots + rebuild-on-miss
+must reproduce the unlimited pool's model (HistogramPool,
+feature_histogram.hpp:646-820)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _train(extra, n=3000, rounds=3, leaves=31):
+    rng = np.random.RandomState(3)
+    X = rng.randn(n, 6).astype(np.float32)
+    y = (X[:, 0] * 1.5 + np.sin(X[:, 1] * 2) + 0.4 * X[:, 2] * X[:, 3]
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": leaves,
+              "verbosity": -1, "min_data_in_leaf": 5, **extra}
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X
+
+
+def test_capped_pool_matches_unlimited():
+    full, X = _train({})
+    # ~2 slots: every parent histogram must be rebuilt from rows
+    tiny, _ = _train({"histogram_pool_size": 1e-4})
+    assert tiny._impl.grow_params.pool_slots == 2
+    np.testing.assert_allclose(tiny.predict(X), full.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    # identical tree structure, not merely close predictions
+    for tf, tt in zip(full._impl.models, tiny._impl.models):
+        np.testing.assert_array_equal(tf.split_feature[:tf.num_nodes],
+                                      tt.split_feature[:tt.num_nodes])
+        np.testing.assert_array_equal(tf.split_leaf[:tf.num_nodes],
+                                      tt.split_leaf[:tt.num_nodes])
+
+
+def test_mid_size_pool_matches():
+    full, X = _train({})
+    bytes_per_hist = 6 * 256 * 3 * 4
+    mid, _ = _train({"histogram_pool_size":
+                     10 * bytes_per_hist / (1024.0 * 1024.0)})
+    assert 2 < mid._impl.grow_params.pool_slots < 31
+    np.testing.assert_allclose(mid.predict(X), full.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pool_cap_larger_than_needed_is_uncapped():
+    big, _ = _train({"histogram_pool_size": 4096})
+    assert big._impl.grow_params.pool_slots == 0
+
+
+def test_capped_pool_multiclass():
+    """Capped multiclass takes the sequential-classes path (lax.map)."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(1500, 5).astype(np.float32)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(int) + (X[:, 2] > 0)
+    kw = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+          "verbosity": -1}
+    full = lgb.train(dict(kw), lgb.Dataset(X, label=y), num_boost_round=3)
+    tiny = lgb.train(dict(kw, histogram_pool_size=1e-4),
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+    assert tiny._impl.grow_params.pool_slots == 2
+    np.testing.assert_allclose(tiny.predict(X), full.predict(X),
+                               rtol=1e-5, atol=1e-6)

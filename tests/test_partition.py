@@ -54,7 +54,7 @@ def test_partition_matches_masked(num_leaves, chunk):
         p = GrowParams(num_leaves=num_leaves, num_bins=b, max_depth=-1,
                        split=_split_params(), row_chunk=chunk,
                        hist_impl="scatter", use_partition=mode)
-        t, li = jax.jit(functools.partial(grow_tree, params=p))(
+        t, li, _ = jax.jit(functools.partial(grow_tree, params=p))(
             jnp.asarray(xb), jnp.asarray(grad), jnp.asarray(hess),
             jnp.asarray(mask), meta, fm)
         out[mode] = (jax.tree.map(np.asarray, t), np.asarray(li))
