@@ -73,7 +73,6 @@ def run_bench(backend_info: dict) -> dict:
     f = HIGGS_FEATURES
     num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
     iters = int(os.environ.get("BENCH_ITERS", 10))
-    warmup = 2
     if backend_info.get("fallback"):
         # CPU fallback: keep the shape honest but the wall-clock sane
         n = min(n, int(os.environ.get("BENCH_ROWS_CPU", 200_000)))
@@ -91,21 +90,27 @@ def run_bench(backend_info: dict) -> dict:
 
     import jax
     t_setup0 = time.time()
-    cfg = Config({"objective": "binary", "num_leaves": num_leaves,
-                  "max_bin": 255, "verbosity": -1})
+    cfg_d = {"objective": "binary", "num_leaves": num_leaves,
+             "max_bin": 255, "verbosity": -1}
+    # sweep hook: BENCH_HIST_IMPL in {auto, matmul, scatter, pallas}
+    if os.environ.get("BENCH_HIST_IMPL"):
+        cfg_d["tpu_hist_impl"] = os.environ["BENCH_HIST_IMPL"]
+    cfg = Config(cfg_d)
     ds = BinnedDataset.from_matrix(X, cfg, label=y)
     b = create_boosting(cfg, ds, create_objective(cfg), [])
     t_bin = time.time() - t_setup0
 
     t_c0 = time.time()
-    for _ in range(warmup):
-        b.train_one_iter()
+    # warm with the SAME block size so the timed section reuses the
+    # compiled fused block
+    b.train_many(iters)
     jax.block_until_ready(b.scores)
     t_compile_warmup = time.time() - t_c0
 
     t0 = time.time()
-    for _ in range(iters):
-        b.train_one_iter()
+    # fused on-device blocks (lax.scan over iterations) — the measured
+    # path is the real training path engine.train uses with no callbacks
+    b.train_many(iters)
     jax.block_until_ready(b.scores)
     dt = time.time() - t0
 

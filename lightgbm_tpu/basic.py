@@ -26,10 +26,43 @@ from .boosting import create_boosting
 _label_from_pandas_warned = False
 
 
+def _pandas_frame_to_array(df, pandas_categorical=None):
+    """DataFrame -> (float64 array, cat column names, category lists).
+
+    Category-dtype columns become their integer codes (NaN for missing/
+    unseen) and their category orders are recorded at train time /
+    re-applied at predict time, so raw category values map to identical
+    codes across sessions — the semantics of the reference's
+    _data_from_pandas (python-package/lightgbm/basic.py:255) and its
+    pandas_categorical model-file sidecar.
+    """
+    cat_cols = [c for c in df.columns
+                if str(df[c].dtype) == "category"]
+    if pandas_categorical is not None:
+        # prediction against a trained mapping: the frame must present the
+        # same categorical columns (e.g. a CSV reload that lost the
+        # category dtype would otherwise be misread as raw codes)
+        check(len(pandas_categorical) == len(cat_cols),
+              "train and predict data have different categorical columns")
+    if not cat_cols:
+        return df.values.astype(np.float64), [], pandas_categorical
+    df = df.copy(deep=False)
+    if pandas_categorical is None:     # training: record category order
+        pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
+    else:                              # prediction: align to trained order
+        for c, cats in zip(cat_cols, pandas_categorical):
+            df[c] = df[c].cat.set_categories(cats)
+    for c in cat_cols:
+        codes = df[c].cat.codes.astype(np.float64)
+        df[c] = codes.where(codes >= 0, np.nan)
+    return df.values.astype(np.float64), [str(c) for c in cat_cols], \
+        pandas_categorical
+
+
 def _to_2d_float(data) -> np.ndarray:
     """Accept ndarray / list / pandas DataFrame / scipy sparse."""
     if hasattr(data, "values") and hasattr(data, "dtypes"):  # DataFrame
-        data = data.values
+        data = _pandas_frame_to_array(data)[0]
     if hasattr(data, "toarray"):  # scipy sparse
         data = data.toarray()
     arr = np.asarray(data, dtype=np.float64)
@@ -106,6 +139,14 @@ class Dataset:
                 self.init_score = parser_mod.load_init_score_file(data)
             data = X
 
+        pandas_cat_cols: List[str] = []
+        if hasattr(data, "dtypes") and hasattr(data, "columns"):
+            if self.pandas_categorical is None and self.reference is not None:
+                # valid sets encode categories in the TRAINING set's order
+                self.pandas_categorical = self.reference.pandas_categorical
+            data, pandas_cat_cols, self.pandas_categorical = \
+                _pandas_frame_to_array(data, self.pandas_categorical)
+
         from .io.dataset import _is_sparse
         if _is_sparse(data):
             # scipy sparse flows through un-densified: BinnedDataset bins it
@@ -123,6 +164,11 @@ class Dataset:
         cat = self.categorical_feature
         if cat == "auto" or cat is None:
             cat = None
+        if pandas_cat_cols:
+            # pandas category columns are categorical whether or not the
+            # user listed them (auto-detection, _data_from_pandas)
+            cat = list(cat) if cat else []
+            cat.extend(c for c in pandas_cat_cols if c not in cat)
         if self.used_indices is not None:
             # subset construction (basic.py subset/used_indices path)
             X = X[self.used_indices] if not hasattr(X, "tocsr") \
@@ -310,6 +356,7 @@ class Booster:
             if train_set._binned is None else train_set.params
         train_set.construct()
         self._train_set = train_set
+        self.pandas_categorical = train_set.pandas_categorical
         self.config = Config(self.params)
         binned = train_set._binned
 
@@ -337,6 +384,17 @@ class Booster:
         self.train_set_name = "training"
 
     def _init_from_string(self, model_str: str) -> None:
+        # pandas_categorical sidecar (may be absent in reference-written
+        # files that predate it or carried 'null')
+        for line in model_str.splitlines()[::-1]:
+            if line.startswith("pandas_categorical:"):
+                import json as _json
+                try:
+                    self.pandas_categorical = _json.loads(
+                        line[len("pandas_categorical:"):])
+                except ValueError:
+                    pass
+                break
         parsed = model_text.parse_model_string(model_str)
         self._loaded = parsed
         params = dict(self.params)
@@ -462,6 +520,9 @@ class Booster:
         if isinstance(data, Dataset):
             raise LightGBMError("Cannot use Dataset instance for prediction, "
                                 "please use raw data instead")
+        if hasattr(data, "dtypes") and hasattr(data, "columns") \
+                and self.pandas_categorical is not None:
+            data = _pandas_frame_to_array(data, self.pandas_categorical)[0]
         X = _to_2d_float(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 \
@@ -565,10 +626,27 @@ class Booster:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 \
                 else -1
-        return model_text.model_to_string(
+        out = model_text.model_to_string(
             self._impl, self._feature_names(), self._feature_infos(),
             num_iteration=num_iteration, start_iteration=start_iteration,
             parameters=param_dict_to_str(self.params))
+        # the reference's python package appends this sidecar line so raw
+        # pandas category values survive save/load (basic.py
+        # _dump_pandas_categorical); keep the format identical for interop
+        import json as _json
+
+        def _cat_value(v):
+            # numeric category values must stay numeric through JSON or
+            # set_categories() at load time matches nothing
+            if isinstance(v, np.integer):
+                return int(v)
+            if isinstance(v, np.floating):
+                return float(v)
+            return str(v)
+
+        out += "\npandas_categorical:%s\n" % _json.dumps(
+            self.pandas_categorical, default=_cat_value)
+        return out
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":

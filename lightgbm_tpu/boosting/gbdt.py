@@ -330,6 +330,8 @@ class GBDT:
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._bag_mask = jnp.ones((n,), jnp.float32)
         self._compiled_iter = None
+        self._iter_core = None
+        self._compiled_block = None
         self._valid_pred_cache: Dict[int, jnp.ndarray] = {}
 
     def add_valid_data(self, ds: BinnedDataset, metrics: List[Metric]) -> None:
@@ -501,7 +503,6 @@ class GBDT:
 
         forced_splits = self._forced_splits
 
-        @jax.jit
         def run_iter(scores, sample_mask, feature_mask,
                      grad_in, hess_in, lr, goss_active, goss_key,
                      cegb_state, stopped_in):
@@ -613,7 +614,106 @@ class GBDT:
             return pack_trees(trees), leaf_ids, new_scores, cegb_new, \
                 stopped_out
 
-        return run_iter
+        self._iter_core = run_iter   # unjitted: train_many scans over it
+        return jax.jit(run_iter)
+
+    def _make_train_block_fn(self) -> Callable:
+        """Fuse ``block`` boosting iterations into ONE device program
+        (lax.scan over the single-iteration core). The whole boosting loop
+        — gradients, bagging refresh, GOSS sampling, tree growth, score
+        update — runs on device with no host round trips; trees come back
+        stacked [block, K, T] for the async flush. This is the TPU-native
+        shape of GBDT::Train (gbdt.cpp:243-261): the reference's per-iter
+        host loop exists because its learner lives in host memory; ours
+        does not.
+        """
+        core = self._iter_core
+        cfg = self.config
+        n, k = self.num_data, self.num_tree_per_iteration
+        bag_enabled = cfg.bagging_freq > 0 and 0.0 < cfg.bagging_fraction \
+            < 1.0
+        freq = max(cfg.bagging_freq, 1)
+        frac = cfg.bagging_fraction
+        row_valid = self._row_valid
+
+        @jax.jit
+        def run_block(scores, feature_masks, goss_actives, iter_idxs, keys,
+                      bag_mask0, cegb_state, stopped_in, lr):
+            g0 = jnp.zeros((n, k), jnp.float32)
+            h0 = jnp.ones((n, k), jnp.float32)
+
+            def step(carry, xs):
+                sc, bag_mask, cegb, stopped = carry
+                fm, ga, it, key = xs
+                bkey, gkey = jax.random.split(key)
+                if bag_enabled:
+                    # bagging refresh on schedule (gbdt.cpp:180-241)
+                    refresh = (it % freq) == 0
+                    new_mask = (jax.random.uniform(bkey, (n,)) < frac) \
+                        .astype(jnp.float32)
+                    bag_mask = jnp.where(refresh, new_mask, bag_mask)
+                sm = bag_mask if row_valid is None else bag_mask * row_valid
+                packed, _leaf_ids, sc2, cegb2, stopped2 = core(
+                    sc, sm, fm, g0, h0, lr, ga, gkey, cegb, stopped)
+                return (sc2, bag_mask, cegb2, stopped2), packed
+
+            carry, packs = lax.scan(
+                step, (scores, bag_mask0, cegb_state, stopped_in),
+                (feature_masks, goss_actives, iter_idxs, keys))
+            new_scores, bag_mask, cegb_out, stopped_out = carry
+            return packs, new_scores, bag_mask, cegb_out, stopped_out
+
+        return run_block
+
+    def train_many(self, num_iters: int) -> bool:
+        """Run ``num_iters`` iterations, fusing them into on-device blocks
+        when no per-iteration host work is required. Returns True when
+        training stopped. Boosting modes with per-iteration host logic
+        (DART's drop sets, RF's re-averaging, percentile-renew objectives,
+        custom gradients) fall back to the per-iteration path.
+        """
+        eligible = (self.boosting_type in ("gbdt", "goss")
+                    and not self._needs_host_per_iter
+                    and not self._use_input_grads)
+        if not eligible:
+            for _ in range(num_iters):
+                if self.train_one_iter():
+                    return True
+            return False
+
+        self._boost_from_average()
+        if self._iter_core is None:
+            self._compiled_iter = self._make_train_iter_fn()
+        if self._compiled_block is None:
+            # one jitted scan; jax caches a compilation per block length
+            self._compiled_block = self._make_train_block_fn()
+
+        done = 0
+        while done < num_iters and not self._stopped:
+            block = min(num_iters - done, 64)
+            fn = self._compiled_block
+            fmasks = jnp.stack([self._sample_feature_mask()
+                                for _ in range(block)])
+            gactive = jnp.asarray(
+                [self._goss_active(self.iter_ + i) for i in range(block)],
+                jnp.float32)
+            idxs = jnp.arange(self.iter_, self.iter_ + block, dtype=jnp.int32)
+            all_keys = jax.random.split(self._bag_key, block + 1)
+            self._bag_key = all_keys[0]
+            packs, self.scores, self._bag_mask, self._cegb_state, \
+                self._stopped_dev = fn(
+                    self.scores, fmasks, gactive, idxs, all_keys[1:],
+                    self._bag_mask, self._cegb_state, self._stopped_dev,
+                    jnp.float32(self.shrinkage_rate))
+            self._pending.append({"packed": packs,
+                                  "shrinkage": self.shrinkage_rate,
+                                  "count": block})
+            self.iter_ += block
+            done += block
+            if sum(p.get("count", 1) for p in self._pending) \
+                    >= self._flush_every:
+                self._materialize()
+        return self._stopped
 
     def _goss_active(self, iter_idx: int) -> float:
         return 0.0
@@ -681,15 +781,16 @@ class GBDT:
         self.scores = new_scores
         self._cegb_state = cegb_new
 
-        pend: Dict[str, Any] = {"packed": packed,
-                                "shrinkage": self.shrinkage_rate}
+        pend: Dict[str, Any] = {"packed": packed[None],  # [1, K, T] block
+                                "shrinkage": self.shrinkage_rate,
+                                "count": 1}
         if self._needs_host_per_iter:
             pend.update(leaf_ids=leaf_ids, sample_mask=sample_mask,
                         prev_scores=prev_scores)
         self._pending.append(pend)
         self.iter_ += 1
         if self._needs_host_per_iter or \
-                len(self._pending) >= self._flush_every:
+                sum(p["count"] for p in self._pending) >= self._flush_every:
             return self._materialize()
         return False
 
@@ -705,35 +806,44 @@ class GBDT:
         pend, self._pending = self._pending, []
         k = self.num_tree_per_iteration
         l = self.config.num_leaves
-        buf = np.asarray(jnp.stack([p["packed"] for p in pend]))  # [P, K, T]
-        for pi, p in enumerate(pend):
-            host_trees = []
-            any_split = False
-            for c in range(k):
-                t = unpack_tree(buf[pi, c], l)
-                ht = self._extract_host_tree(t)
-                if ht.num_leaves_actual > 1:
-                    any_split = True
-                host_trees.append(ht)
-            if not any_split:
-                Log.warning("Stopped training because there are no more "
-                            "leaves that meet the split requirements")
-                if not self._models:
-                    # keep a constant tree so the model reproduces the init
-                    # score (AsConstantTree path, gbdt.cpp:379-396)
-                    inits = getattr(self, "init_score_offsets",
-                                    np.zeros(k, np.float32))
-                    for c in range(k):
-                        ht = host_trees[c]
-                        ht.num_leaves_actual = 1
-                        ht.leaf_value[:] = 0.0
-                        ht.leaf_value[0] = float(inits[c])
-                        ht.split_leaf[:] = -1
-                        self._models.append(ht)
-                self._stopped = True
-                self.iter_ = len(self._models) // max(k, 1)
+        # every pending entry is a [B_i, K, T] block (B_i == 1 for
+        # per-iteration dispatches); ONE transfer for the whole backlog
+        buf = np.asarray(jnp.concatenate([p["packed"] for p in pend],
+                                         axis=0))  # [sum(B_i), K, T]
+        row = 0
+        for p in pend:
+            if self._stopped:
                 break
-            self._store_host_trees(host_trees, p)
+            for _ in range(p["count"]):
+                host_trees = []
+                any_split = False
+                for c in range(k):
+                    t = unpack_tree(buf[row, c], l)
+                    ht = self._extract_host_tree(t)
+                    if ht.num_leaves_actual > 1:
+                        any_split = True
+                    host_trees.append(ht)
+                row += 1
+                if not any_split:
+                    Log.warning("Stopped training because there are no "
+                                "more leaves that meet the split "
+                                "requirements")
+                    if not self._models:
+                        # keep a constant tree so the model reproduces the
+                        # init score (AsConstantTree, gbdt.cpp:379-396)
+                        inits = getattr(self, "init_score_offsets",
+                                        np.zeros(k, np.float32))
+                        for c in range(k):
+                            ht = host_trees[c]
+                            ht.num_leaves_actual = 1
+                            ht.leaf_value[:] = 0.0
+                            ht.leaf_value[0] = float(inits[c])
+                            ht.split_leaf[:] = -1
+                            self._models.append(ht)
+                    self._stopped = True
+                    self.iter_ = len(self._models) // max(k, 1)
+                    break
+                self._store_host_trees(host_trees, p)
         return self._stopped
 
     def _store_host_trees(self, host_trees: List[HostTree],

@@ -52,6 +52,9 @@ def _eval_text(entry, show_stdv: bool = True) -> str:
 class _PrintEvaluation:
     before_iteration = False
     order = 10
+    # no-op whenever the iteration produced no eval results — lets the
+    # engine fuse iteration blocks on device when nothing is evaluated
+    only_consumes_evals = True
 
     def __init__(self, period: int, show_stdv: bool):
         self.period = period
@@ -75,6 +78,7 @@ def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
 class _RecordEvaluation:
     before_iteration = False
     order = 20
+    only_consumes_evals = True
 
     def __init__(self, store: Dict[str, Dict[str, List[float]]]):
         self.store = store

@@ -104,6 +104,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # boosting loop (engine.py:211-246)
     init_iteration = booster.current_iteration
     finished_early = False
+    evaluation_result_list = []
+    if valid_sets is None and fobj is None and not cbs_before and \
+            all(getattr(c, "only_consumes_evals", False) for c in cbs_after):
+        # nothing needs the host between iterations (eval-display callbacks
+        # are no-ops with no valid sets): fuse the whole loop into
+        # on-device blocks (GBDT.train_many)
+        booster._impl.train_many(num_boost_round)
+        num_boost_round = 0
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in cbs_before:
             cb(callback.CallbackEnv(

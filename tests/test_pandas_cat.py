@@ -1,0 +1,113 @@
+"""pandas category-dtype handling: codes at train time, identical mapping
+at predict time, persisted through the model file — the semantics of the
+reference's _data_from_pandas + pandas_categorical sidecar
+(python-package/lightgbm/basic.py:255)."""
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture()
+def frame():
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "a": rng.randn(800),
+        "b": pd.Categorical(rng.choice(["x", "y", "z"], 800)),
+        "c": rng.randn(800),
+    })
+    y = ((df["a"] + (df["b"] == "x") * 2) > 0).astype(float)
+    return df, y
+
+
+def test_category_columns_train_and_predict(frame):
+    df, y = frame
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15},
+                    lgb.Dataset(df, label=y), num_boost_round=8)
+    assert roc_auc_score(y, bst.predict(df)) > 0.95
+    # the category column must actually be used as categorical
+    imp = bst.feature_importance()
+    assert imp[1] > 0
+
+
+def test_predict_is_category_order_invariant(frame):
+    """Codes follow the TRAINED category order, not the frame's."""
+    df, y = frame
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    df2 = df.copy()
+    df2["b"] = pd.Categorical(df["b"].astype(str),
+                              categories=["z", "x", "y"])
+    np.testing.assert_array_equal(bst.predict(df), bst.predict(df2))
+
+
+def test_pandas_categorical_survives_model_roundtrip(frame, tmp_path):
+    df, y = frame
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    assert "pandas_categorical:" in path.read_text()
+    loaded = lgb.Booster(model_file=str(path))
+    np.testing.assert_array_equal(loaded.predict(df), bst.predict(df))
+
+
+def test_numeric_categories_roundtrip(tmp_path):
+    """Integer category values must stay numeric through the JSON sidecar."""
+    rng = np.random.RandomState(1)
+    df = pd.DataFrame({
+        "a": rng.randn(600),
+        "b": pd.Categorical(rng.choice([10, 20, 30], 600)),
+    })
+    y = ((df["a"] + (df["b"] == 10) * 2) > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_array_equal(loaded.predict(df), bst.predict(df))
+
+
+def test_valid_set_aligned_to_train_categories():
+    """Validation frames encode categories in the TRAINING set's order."""
+    rng = np.random.RandomState(2)
+
+    def mk(n, cats):
+        df = pd.DataFrame({
+            "a": rng.randn(n),
+            "b": pd.Categorical(rng.choice(["x", "y", "z"], n),
+                                categories=cats),
+        })
+        y = ((df["a"] + (df["b"] == "x") * 2) > 0).astype(float)
+        return df, y
+
+    df_t, y_t = mk(800, ["x", "y", "z"])
+    df_v, y_v = mk(300, ["z", "x", "y"])   # permuted category order
+    train = lgb.Dataset(df_t, label=y_t)
+    res = {}
+    lgb.train({"objective": "binary", "metric": "auc", "verbosity": -1},
+              train, num_boost_round=8,
+              valid_sets=[train.create_valid(df_v, label=y_v)],
+              evals_result=res, verbose_eval=False)
+    assert res["valid_0"]["auc"][-1] > 0.95
+
+
+def test_mismatched_categorical_columns_raise(frame):
+    df, y = frame
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=3)
+    df2 = df.copy()
+    df2["b"] = df2["b"].astype(str)   # lost the category dtype
+    with pytest.raises(lgb.LightGBMError):
+        bst.predict(df2)
+
+
+def test_unseen_category_goes_to_missing(frame):
+    df, y = frame
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    df2 = df.head(10).copy()
+    df2["b"] = pd.Categorical(["w"] * 10)  # never seen in training
+    out = bst.predict(df2)   # must not raise; unseen -> NaN -> default path
+    assert out.shape == (10,)

@@ -173,3 +173,22 @@ def test_grow_tree_explicit_psum_path():
     np.testing.assert_allclose(np.asarray(tree_dp.leaf_value),
                                np.asarray(tree_ref.leaf_value),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_goss_under_mesh_uses_real_counts():
+    """GOSS top-k must size its threshold from the REAL row count, not the
+    mesh-padding-inflated one (goss.hpp:87-135): padded rows carry
+    |g*h| = 0, so with correct counts the sampled multiplier set matches a
+    serial run closely. n is chosen to NOT divide 8 so padding exists."""
+    X, y = make_binary(n=1501)
+    params = {"objective": "binary", "metric": "auc", "boosting": "goss",
+              "top_rate": 0.3, "other_rate": 0.2, "learning_rate": 0.1,
+              "verbosity": -1}
+    meshed = _train(dict(params, tree_learner="data"), X, y, rounds=12)
+    assert meshed.num_data > 1501  # padding really happened
+    serial = _train(params, X, y, rounds=12)
+    auc_m = dict((m, v) for _, m, v, _ in meshed.get_eval_at(0))["auc"]
+    auc_s = dict((m, v) for _, m, v, _ in serial.get_eval_at(0))["auc"]
+    # GOSS sampling is stochastic; equal-count semantics keep AUC in step
+    assert auc_m > 0.9
+    assert abs(auc_m - auc_s) < 0.05

@@ -1,0 +1,76 @@
+"""Fused multi-iteration training (GBDT.train_many: lax.scan over the
+iteration core — the whole boosting loop as one device program)."""
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster, Dataset
+
+
+def _xy(n=4000, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def test_fused_matches_per_iteration_exactly():
+    """With no stochastic sampling the fused block must be bit-identical
+    to the per-iteration dispatch path."""
+    X, y = _xy()
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 31}
+    fused = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=8)  # engine takes the fused path
+    # prove the fused path actually engaged (only train_many compiles it) —
+    # engine.train's default print_evaluation callback must not block it
+    assert fused._impl._compiled_block is not None
+    periter = Booster(params=dict(params), train_set=Dataset(X, label=y))
+    for _ in range(8):
+        periter.update()
+    assert periter._impl._compiled_block is None
+    np.testing.assert_array_equal(
+        fused.predict(X[:400], raw_score=True),
+        periter.predict(X[:400], raw_score=True))
+
+
+def test_fused_bagging_and_feature_fraction():
+    X, y = _xy()
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 31, "bagging_freq": 2,
+                     "bagging_fraction": 0.7, "feature_fraction": 0.8},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_fused_goss():
+    X, y = _xy(seed=1)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "boosting": "goss", "top_rate": 0.3,
+                     "other_rate": 0.2},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_fused_stop_inside_block():
+    """Convergence mid-block: the device stop latch freezes scores and the
+    flush truncates the model at the stump."""
+    rng = np.random.RandomState(2)
+    Xs = rng.randn(60, 3).astype(np.float32)
+    ys = (Xs[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 63, "min_data_in_leaf": 1,
+                     "learning_rate": 0.5},
+                    lgb.Dataset(Xs, label=ys), num_boost_round=100)
+    assert bst.num_trees() < 100
+    raw = bst.predict(Xs, raw_score=True)
+    sc = np.asarray(bst._impl.scores)[:, 0]
+    assert np.abs(raw - sc).max() < 1e-4
+
+
+def test_train_many_block_boundaries():
+    """num_iters > 64 spans multiple blocks; model length is exact."""
+    X, y = _xy(n=800, f=4)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=70)
+    assert bst.num_trees() == 70
