@@ -111,7 +111,13 @@ def _pad_feature_meta(meta: FeatureMeta, fpad: int) -> FeatureMeta:
                                         meta.col.shape[0] + fpad,
                                         dtype=jnp.int32)]),
         offset=jnp.concatenate([meta.offset, jnp.zeros((fpad,), jnp.int32)]),
-        bundled=jnp.concatenate([meta.bundled, jnp.zeros((fpad,), bool)]))
+        bundled=jnp.concatenate([meta.bundled, jnp.zeros((fpad,), bool)]),
+        pack_div=jnp.concatenate([meta.pack_div,
+                                  jnp.ones((fpad,), jnp.int32)]),
+        pack_mod=jnp.concatenate([meta.pack_mod,
+                                  jnp.zeros((fpad,), jnp.int32)]),
+        pack_partner=jnp.concatenate([meta.pack_partner,
+                                      jnp.ones((fpad,), jnp.int32)]))
 
 
 def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta:
@@ -144,13 +150,16 @@ def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta
               "features" % (len(mc), ds.num_total_features))
         for j in range(f):
             monotone[j] = mc[ds.used_features[j]]
-    feat_col, feat_offset, feat_bundled = ds.feature_layout()
+    (feat_col, feat_offset, feat_bundled, pack_div, pack_mod,
+     pack_partner) = ds.feature_layout()
     return FeatureMeta(
         num_bin=jnp.asarray(num_bin), missing_type=jnp.asarray(missing),
         default_bin=jnp.asarray(default_bin), is_categorical=jnp.asarray(is_cat),
         penalty=jnp.asarray(penalty), monotone=jnp.asarray(monotone),
         col=jnp.asarray(feat_col), offset=jnp.asarray(feat_offset),
-        bundled=jnp.asarray(feat_bundled))
+        bundled=jnp.asarray(feat_bundled),
+        pack_div=jnp.asarray(pack_div), pack_mod=jnp.asarray(pack_mod),
+        pack_partner=jnp.asarray(pack_partner))
 
 
 class GBDT:
@@ -228,10 +237,11 @@ class GBDT:
                 xb_np = np.concatenate(
                     [xb_np, np.zeros((xb_np.shape[0], fpad), xb_np.dtype)],
                     axis=1)
-        if self.mesh is not None and ds.has_bundles:
+        if self.mesh is not None and (ds.has_bundles or ds.has_packed):
             raise LightGBMError(
-                "EFB bundles are not yet supported with a device mesh; "
-                "set enable_bundle=false for distributed training")
+                "EFB bundles / nbit-packed columns are not yet supported "
+                "with a device mesh; set enable_bundle=false and "
+                "enable_nbit_packing=false for distributed training")
         self.num_data = xb_np.shape[0]
         self._feature_pad = xb_np.shape[1] - ds.num_columns
         self._row_valid = (jnp.asarray(row_valid) if row_valid is not None
@@ -305,8 +315,13 @@ class GBDT:
             with_categorical=bool(np.asarray(self.feature_meta.is_categorical)
                                   .any()),
             use_partition=(self.mesh is None),
-            with_efb=ds.has_bundles,
-            num_feat_bins=self.num_feat_bins)
+            with_efb=ds.has_bundles or ds.has_packed,
+            num_feat_bins=self.num_feat_bins,
+            # single source of truth: the marginalization width IS the
+            # largest pack_partner the layout recorded
+            pack_j=int(np.asarray(self.feature_meta.pack_partner).max()
+                       if self.feature_meta.pack_partner is not None
+                       and self.feature_meta.pack_partner.size else 1))
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -981,7 +996,7 @@ class GBDT:
     def _replay_leaves_binned_impl(split_leaf, stored_col, bin_offset,
                                    threshold_bin, default_left, missing_type,
                                    is_cat, cat_bitset, num_bin, default_bin,
-                                   xb):
+                                   pack_div, pack_mod, xb):
         from ..core.grow import _bin_go_left, decode_bundle_value
         n = xb.shape[0]
         num_nodes = split_leaf.shape[0]
@@ -990,7 +1005,9 @@ class GBDT:
             active = split_leaf[t] >= 0
             col = jnp.take(xb, stored_col[t], axis=1)
             binv = decode_bundle_value(col, bin_offset[t], num_bin[t],
-                                       default_bin[t])
+                                       default_bin[t],
+                                       pack_div=pack_div[t],
+                                       pack_mod=pack_mod[t])
             go_left = _bin_go_left(binv, threshold_bin[t], default_left[t],
                                    missing_type[t], num_bin[t], default_bin[t],
                                    is_cat[t], cat_bitset[t])
@@ -1002,7 +1019,7 @@ class GBDT:
 
     def _replay_leaves_binned(self, ht: HostTree, xb: jnp.ndarray) -> jnp.ndarray:
         ds = self.train_data
-        feat_col, feat_offset, _ = ds.feature_layout()
+        feat_col, feat_offset, _, pack_div, pack_mod, _ = ds.feature_layout()
         inner = np.array([max(ds.inner_feature_index(int(f)), 0)
                           for f in ht.split_feature], np.int32)
         num_bin = np.array([ds.bin_mappers[int(f)].num_bin
@@ -1015,7 +1032,8 @@ class GBDT:
             jnp.asarray(ht.threshold_bin), jnp.asarray(ht.default_left),
             jnp.asarray(ht.missing_type), jnp.asarray(ht.is_categorical),
             jnp.asarray(ht.cat_bitset_bin), jnp.asarray(num_bin),
-            jnp.asarray(default_bin), xb)
+            jnp.asarray(default_bin), jnp.asarray(pack_div[inner]),
+            jnp.asarray(pack_mod[inner]), xb)
 
     # ------------------------------------------------------------ evaluation
     def get_eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:

@@ -100,6 +100,10 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
                                          "valid_init_score_file", "valid_init_score"]),
     ("pre_partition", bool, False, ["is_pre_partition"]),
     ("enable_bundle", bool, True, ["is_enable_bundle", "bundle"]),
+    # pack pairs of <=16-bin features into one stored column via joint
+    # encoding (the Dense4bitsBin analog, dense_nbits_bin.hpp) — halves
+    # both storage bytes and histogram columns for small-bin features
+    ("enable_nbit_packing", bool, True, ["nbit_packing"]),
     ("max_conflict_rate", float, 0.0, []),
     ("is_enable_sparse", bool, True, ["is_sparse", "enable_sparse", "sparse"]),
     ("sparse_threshold", float, 0.8, []),

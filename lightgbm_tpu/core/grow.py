@@ -67,6 +67,9 @@ class GrowParams(NamedTuple):
     # meta.col/offset. num_feat_bins = 0 means "same as num_bins".
     with_efb: bool = False
     num_feat_bins: int = 0
+    # joint-coded pair packing: max marginalization width (the largest
+    # pack_partner; 1 = no packed columns, expand() stays a pure gather)
+    pack_j: int = 1
     # forced splits (serial_tree_learner.cpp ForceSplits :593-751): the
     # first `num_forced` loop steps split a BFS-predetermined (leaf,
     # feature, threshold) instead of the best-gain candidate
@@ -211,15 +214,24 @@ def _masked_set(arr: jnp.ndarray, idx: jnp.ndarray, val, valid) -> jnp.ndarray:
 
 def decode_bundle_value(v: jnp.ndarray, offset: jnp.ndarray,
                         num_bin: jnp.ndarray,
-                        default_bin: jnp.ndarray) -> jnp.ndarray:
-    """Stored bundle-column value -> the feature's own bin index.
+                        default_bin: jnp.ndarray,
+                        pack_div=None, pack_mod=None) -> jnp.ndarray:
+    """Stored column value -> the feature's own bin index.
 
-    A value inside [offset, offset + num_bin) belongs to this feature;
-    anything else means some bundle-mate (or the shared zero slot) is active,
-    i.e. this feature sits at its default bin (io/bundle.py encoding).
+    EFB bundles: a value inside [offset, offset + num_bin) belongs to this
+    feature; anything else means some bundle-mate (or the shared zero slot)
+    is active, i.e. this feature sits at its default bin (io/bundle.py
+    encoding). Joint-coded pair columns (io/dataset.py _pack_small_pairs):
+    the feature's bin is a base-`pack_div` digit of the stored value.
     Identity for singleton columns (offset 0, values always in range).
     """
-    vv = v.astype(jnp.int32) - offset
+    vv = v.astype(jnp.int32)
+    if pack_div is not None:
+        packed = pack_mod > 0
+        vv = jnp.where(packed,
+                       (vv // jnp.maximum(pack_div, 1))
+                       % jnp.maximum(pack_mod, 1), vv)
+    vv = vv - offset
     return jnp.where((vv >= 0) & (vv < num_bin), vv, default_bin)
 
 
@@ -277,19 +289,39 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def expand(hist, sum_g, sum_h, cnt):
         """[C, B, 3] column histograms -> [F, Bf, 3] per-feature views.
 
-        Each feature's bins are a contiguous slice of its column
+        EFB: each feature's bins are a contiguous slice of its column
         (feature_group.h bin_offsets_). A bundled feature's default bin is
         shared with its bundle-mates, so its entry is rebuilt from leaf
         totals — the Dataset::FixHistogram idea (dataset.h:411-412).
+        Joint-coded pair columns: a feature's bin-b entry is the MARGINAL
+        over the pair-mate's digit — sum of `pack_partner` joint bins at
+        stride pack_div (for the high digit) or pack_mod (low digit).
         """
         if not params.with_efb:
             return hist
         flat = hist.reshape(ncols * b, 3)
         bidx = jnp.arange(bf, dtype=jnp.int32)[None, :]          # [1, Bf]
-        idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
         in_feat = bidx < meta.num_bin[:, None]                   # [F, Bf]
-        out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
-            * in_feat[..., None]
+        if params.pack_j > 1:
+            # generalized gather-sum: unpacked features use stride-1 bins
+            # with a single j term; packed ones marginalize over j
+            packed = meta.pack_mod[:, None, None] > 0            # [F, 1, 1]
+            bstride = jnp.where(packed[..., 0, 0], meta.pack_div, 1)
+            jstride = jnp.where(meta.pack_div > 1, 1,
+                                jnp.maximum(meta.pack_mod, 1))
+            jcount = jnp.where(packed[..., 0, 0], meta.pack_partner, 1)
+            jj = jnp.arange(params.pack_j, dtype=jnp.int32)[None, None, :]
+            idx = (meta.col[:, None, None] * b + meta.offset[:, None, None]
+                   + bidx[..., None] * bstride[:, None, None]
+                   + jj * jstride[:, None, None])                # [F, Bf, J]
+            ok = (jj < jcount[:, None, None]) & in_feat[..., None]
+            out = jnp.sum(
+                jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0)
+                * ok[..., None], axis=2)                         # [F, Bf, 3]
+        else:
+            idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
+            out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
+                * in_feat[..., None]
         totals = jnp.stack([sum_g, sum_h, cnt])                  # [3]
         is_def = bidx == meta.default_bin[:, None]               # [F, Bf]
         sum_wo_def = jnp.sum(jnp.where(is_def[..., None], 0.0, out), axis=1)
@@ -509,9 +541,14 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             stored_col = meta.col[cur.feature]
 
             def to_feat_bin(v):
-                return decode_bundle_value(v, meta.offset[cur.feature],
-                                           meta.num_bin[cur.feature],
-                                           meta.default_bin[cur.feature])
+                return decode_bundle_value(
+                    v, meta.offset[cur.feature],
+                    meta.num_bin[cur.feature],
+                    meta.default_bin[cur.feature],
+                    pack_div=(meta.pack_div[cur.feature]
+                              if meta.pack_div is not None else None),
+                    pack_mod=(meta.pack_mod[cur.feature]
+                              if meta.pack_mod is not None else None))
         else:
             stored_col = cur.feature
 

@@ -50,6 +50,12 @@ class FeatureMeta(NamedTuple):
     col: Optional[jnp.ndarray] = None       # [F] int32
     offset: Optional[jnp.ndarray] = None    # [F] int32
     bundled: Optional[jnp.ndarray] = None   # [F] bool
+    # joint-coded pair packing (io/dataset.py _pack_small_pairs): feature
+    # bin = (stored // pack_div) % pack_mod; pack_partner = the pair-mate's
+    # bin count (marginalization width). div=1/mod=0 = unpacked.
+    pack_div: Optional[jnp.ndarray] = None      # [F] int32
+    pack_mod: Optional[jnp.ndarray] = None      # [F] int32
+    pack_partner: Optional[jnp.ndarray] = None  # [F] int32
 
 
 class SplitParams(NamedTuple):

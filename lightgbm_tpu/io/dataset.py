@@ -120,6 +120,9 @@ class BinnedDataset:
         self.col_features: List[List[int]] = []
         self.col_offsets: List[List[int]] = []
         self.col_num_bin: List[int] = []
+        # joint-coded pairs of small features (Dense4bitsBin analog):
+        # stored value = bin_a * num_bin_b + bin_b
+        self.col_packed: List[bool] = []
         self.metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin: int = 255
@@ -178,6 +181,7 @@ class BinnedDataset:
             self.col_features = reference.col_features
             self.col_offsets = reference.col_offsets
             self.col_num_bin = reference.col_num_bin
+            self.col_packed = reference.col_packed
         else:
             cat_idx = set(_parse_categorical(
                 categorical_feature if categorical_feature is not None
@@ -248,21 +252,36 @@ class BinnedDataset:
                 Log.info("EFB: %d features bundled into %d columns "
                          "(%d multi-feature bundles)",
                          len(self.used_features), len(bundles), n_bundled)
+            self.col_packed = [False] * len(self.col_features)
+            # mesh learners shard/pad the feature axis assuming an identity
+            # feature->column layout; keep packing single-device-only (the
+            # booster raises if a packed dataset reaches a mesh anyway)
+            if config.enable_nbit_packing and \
+                    config.tree_learner == "serial" and not config.mesh_shape:
+                self._pack_small_pairs()
 
         # ---- build the stored uint8 columns ------------------------------
+        def full_bin_column(j):
+            m = self.bin_mappers[j]
+            if sparse:
+                zero_bin = int(m.values_to_bins(np.zeros(1))[0])
+                colb = np.full(n, zero_bin, np.uint8)
+                rows, vals = column_nonzeros(j)
+                if len(rows):
+                    colb[rows] = m.values_to_bins(vals).astype(np.uint8)
+                return colb
+            return m.values_to_bins(data64[:, j]).astype(np.uint8)
+
         cols = []
-        for feats, offs in zip(self.col_features, self.col_offsets):
-            if len(feats) == 1 and offs[0] == 0:
-                j = feats[0]
-                m = self.bin_mappers[j]
-                if sparse:
-                    zero_bin = int(m.values_to_bins(np.zeros(1))[0])
-                    colb = np.full(n, zero_bin, np.uint8)
-                    rows, vals = column_nonzeros(j)
-                    if len(rows):
-                        colb[rows] = m.values_to_bins(vals).astype(np.uint8)
-                else:
-                    colb = m.values_to_bins(data64[:, j]).astype(np.uint8)
+        for ci, (feats, offs) in enumerate(zip(self.col_features,
+                                               self.col_offsets)):
+            if self._col_is_packed(ci):
+                ja, jb = feats
+                nb_b = self.bin_mappers[jb].num_bin
+                colb = (full_bin_column(ja).astype(np.uint16) * nb_b
+                        + full_bin_column(jb)).astype(np.uint8)
+            elif len(feats) == 1 and offs[0] == 0:
+                colb = full_bin_column(feats[0])
             else:
                 colb = np.zeros(n, np.uint8)
                 for off, j in zip(offs, feats):
@@ -367,6 +386,7 @@ class BinnedDataset:
         self.col_features = [[j] for j in self.used_features]
         self.col_offsets = [[0] for _ in self.used_features]
         self.col_num_bin = [mappers[j].num_bin for j in self.used_features]
+        self.col_packed = [False] * len(self.col_features)
 
         data64 = np.asarray(local_data, np.float64)
         cols = [mappers[j].values_to_bins(data64[:, j]).astype(np.uint8)
@@ -415,25 +435,98 @@ class BinnedDataset:
 
     @property
     def has_bundles(self) -> bool:
-        return any(len(b) > 1 for b in self.col_features)
+        return any(len(b) > 1 and not self._col_is_packed(ci)
+                   for ci, b in enumerate(self.col_features))
+
+    def _col_is_packed(self, ci: int) -> bool:
+        return ci < len(self.col_packed) and self.col_packed[ci]
+
+    @property
+    def has_packed(self) -> bool:
+        return any(self.col_packed)
+
+    def _pack_small_pairs(self) -> None:
+        """Joint-code pairs of small singleton numerical features into one
+        stored column (value = bin_a * num_bin_b + bin_b) — the
+        Dense4bitsBin idea (dense_nbits_bin.hpp:38-82) re-shaped for the
+        [N, C] uint8 matrix: instead of nibble-shifting inside a bin
+        object, two features share a column whose joint histogram is
+        marginalized per feature at split-search time. Only applied when
+        the pair fits the dataset's existing histogram width, so B never
+        grows."""
+        b_max = max(self.col_num_bin, default=0)
+        cand = [ci for ci in range(len(self.col_features))
+                if len(self.col_features[ci]) == 1
+                and not self.col_packed[ci]
+                and self.bin_mappers[self.col_features[ci][0]].bin_type
+                != BinType.CATEGORICAL
+                and self.bin_mappers[self.col_features[ci][0]].num_bin <= 16]
+        # widest first, paired greedily while the product fits b_max
+        cand.sort(key=lambda ci:
+                  -self.bin_mappers[self.col_features[ci][0]].num_bin)
+        drop = set()
+        pairs = 0
+        while len(cand) >= 2:
+            ca = cand.pop(0)
+            cb = cand.pop()          # widest with narrowest
+            ja = self.col_features[ca][0]
+            jb = self.col_features[cb][0]
+            nb_a = self.bin_mappers[ja].num_bin
+            nb_b = self.bin_mappers[jb].num_bin
+            if nb_a * nb_b > b_max:
+                break                # widest pair no longer fits
+            self.col_features[ca] = [ja, jb]
+            self.col_offsets[ca] = [0, 0]
+            self.col_num_bin[ca] = nb_a * nb_b
+            self.col_packed[ca] = True
+            drop.add(cb)
+            pairs += 1
+        if drop:
+            keep = [i for i in range(len(self.col_features))
+                    if i not in drop]
+            self.col_features = [self.col_features[i] for i in keep]
+            self.col_offsets = [self.col_offsets[i] for i in keep]
+            self.col_num_bin = [self.col_num_bin[i] for i in keep]
+            self.col_packed = [self.col_packed[i] for i in keep]
+            Log.info("nbit packing: %d small-feature pairs share a column "
+                     "(%d stored columns)", pairs, len(self.col_features))
 
     def feature_layout(self):
         """Per used-feature (inner index) storage arrays:
-        (feat_col, feat_offset, feat_bundled) int32/int32/bool — where each
-        feature lives in the stored matrix and at which bin offset."""
+        (feat_col, feat_offset, feat_bundled, pack_div, pack_mod,
+        pack_partner) — where each feature lives in the stored matrix, at
+        which bin offset (EFB), and how to extract it from a joint-coded
+        pair column (packing): feature bin = (value // div) % mod, with
+        `partner` = the other feature's bin count (marginalization width).
+        div/mod are 1/0 for unpacked features."""
         fcount = self.num_features
         feat_col = np.zeros(fcount, np.int32)
         feat_offset = np.zeros(fcount, np.int32)
         feat_bundled = np.zeros(fcount, bool)
+        pack_div = np.ones(fcount, np.int32)
+        pack_mod = np.zeros(fcount, np.int32)
+        pack_partner = np.ones(fcount, np.int32)
         inner = {j: i for i, j in enumerate(self.used_features)}
         for ci, (feats, offs) in enumerate(zip(self.col_features,
                                                self.col_offsets)):
+            if self._col_is_packed(ci):
+                ja, jb = feats
+                nb_a = self.bin_mappers[ja].num_bin
+                nb_b = self.bin_mappers[jb].num_bin
+                ia, ib = inner[ja], inner[jb]
+                feat_col[ia] = feat_col[ib] = ci
+                pack_div[ia], pack_mod[ia] = nb_b, nb_a
+                pack_partner[ia] = nb_b
+                pack_div[ib], pack_mod[ib] = 1, nb_b
+                pack_partner[ib] = nb_a
+                continue
             for off, j in zip(offs, feats):
                 i = inner[j]
                 feat_col[i] = ci
                 feat_offset[i] = off
                 feat_bundled[i] = len(feats) > 1
-        return feat_col, feat_offset, feat_bundled
+        return (feat_col, feat_offset, feat_bundled, pack_div, pack_mod,
+                pack_partner)
 
     def get_feature_infos(self) -> List[str]:
         """Model-file ``feature_infos`` strings ([min:max] / categorical list)."""
@@ -461,6 +554,7 @@ class BinnedDataset:
             "col_features": self.col_features,
             "col_offsets": self.col_offsets,
             "col_num_bin": self.col_num_bin,
+            "col_packed": self.col_packed,
         }
         arrays: Dict[str, np.ndarray] = {"X_binned": self.X_binned}
         if self.metadata.label is not None:
@@ -493,6 +587,8 @@ class BinnedDataset:
             if not self.col_num_bin:
                 self.col_num_bin = [self.bin_mappers[b[0]].num_bin
                                     for b in self.col_features]
+            self.col_packed = list(meta.get(
+                "col_packed", [False] * len(self.col_features)))
             self.X_binned = z["X_binned"]
             self.metadata = Metadata(self.num_data)
             if "label" in z:

@@ -1,0 +1,85 @@
+"""Joint-coded pair packing of small-bin features (the Dense4bitsBin
+analog, dense_nbits_bin.hpp:38-82): two <=16-bin features share one stored
+uint8 column; per-feature histograms are marginals of the joint histogram.
+
+Must be a pure storage optimization: identical tree structure, predictions
+within float32 accumulation drift of the unpacked run, and B unchanged.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+
+def _mixed_xy(n=4000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([
+        rng.randn(n, 2),                                     # wide bins
+        rng.randint(0, 10, size=(n, 6)).astype(np.float64),  # <=16 bins
+    ], axis=1).astype(np.float32)
+    y = ((X[:, 0] + (X[:, 2] > 5) + (X[:, 3] < 3) * 0.5
+          + 0.3 * X[:, 1]) > 1).astype(np.float32)
+    return X, y
+
+
+def test_packing_reduces_columns_and_matches_structure():
+    X, y = _mixed_xy()
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 31}
+    packed = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    plain = lgb.train(dict(params, enable_nbit_packing=False),
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    ds = packed._impl.train_data
+    assert ds.has_packed
+    assert ds.num_columns == 5      # 2 wide + 3 packed pairs of 6 small
+    assert ds.max_col_bins() == plain._impl.train_data.max_col_bins()
+    for tp, tq in zip(packed._impl.models, plain._impl.models):
+        np.testing.assert_array_equal(tp.split_feature[:tp.num_nodes],
+                                      tq.split_feature[:tq.num_nodes])
+        np.testing.assert_allclose(tp.threshold[:tp.num_nodes],
+                                   tq.threshold[:tq.num_nodes], rtol=1e-6)
+    np.testing.assert_allclose(packed.predict(X, raw_score=True),
+                               plain.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-4)
+    assert roc_auc_score(y, packed.predict(X)) > 0.95
+
+
+def test_packing_skipped_when_it_would_widen_b():
+    """All-small datasets keep narrow histograms; packing must not grow B."""
+    rng = np.random.RandomState(1)
+    X = rng.randint(0, 10, size=(2000, 6)).astype(np.float32)
+    y = ((X[:, 0] > 5) | (X[:, 1] < 3)).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    ds = bst._impl.train_data
+    assert not ds.has_packed          # 11*11 > the ~12-bin column width
+    assert roc_auc_score(y, bst.predict(X)) > 0.95
+
+
+def test_packing_with_missing_values():
+    X, y = _mixed_xy(seed=2)
+    X[::7, 3] = np.nan                # NaN in a packed small feature
+    packed = lgb.train({"objective": "binary", "verbosity": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=6)
+    plain = lgb.train({"objective": "binary", "verbosity": -1,
+                       "enable_nbit_packing": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    assert packed._impl.train_data.has_packed
+    np.testing.assert_allclose(packed.predict(X, raw_score=True),
+                               plain.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_packing_binary_cache_roundtrip(tmp_path):
+    X, y = _mixed_xy(seed=3)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    assert ds.has_packed
+    path = str(tmp_path / "ds.npz")
+    ds.save_binary(path)
+    loaded = BinnedDataset.load_binary(path)
+    assert loaded.col_packed == ds.col_packed
+    np.testing.assert_array_equal(loaded.X_binned, ds.X_binned)
