@@ -1,0 +1,17 @@
+"""Generate binary.train / binary.test (label + 28 features, TSV — the
+reference example's HIGGS-like shape)."""
+import numpy as np
+
+rng = np.random.RandomState(7)
+
+
+def make(n, path):
+    X = rng.randn(n, 28).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.5 * np.sin(X[:, 3] * 3)
+          + 0.3 * rng.randn(n)) > 0).astype(int)
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+
+
+make(7000, "binary.train")
+make(500, "binary.test")
+print("wrote binary.train binary.test")

@@ -1,0 +1,26 @@
+"""Generate rank.train / rank.test + .query sidecars (LambdaRank needs
+query group sizes, the reference's rank.train.query convention)."""
+import numpy as np
+
+rng = np.random.RandomState(17)
+
+
+def make(n_queries, path):
+    rows, labels, sizes = [], [], []
+    for _ in range(n_queries):
+        m = rng.randint(5, 25)
+        X = rng.randn(m, 12).astype(np.float32)
+        rel = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(m)
+        y = np.clip(np.digitize(rel, [-0.5, 0.3, 1.0]), 0, 4)
+        rows.append(X)
+        labels.append(y)
+        sizes.append(m)
+    X = np.concatenate(rows)
+    y = np.concatenate(labels)
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+    np.savetxt(path + ".query", np.asarray(sizes, np.int64), fmt="%d")
+
+
+make(400, "rank.train")
+make(50, "rank.test")
+print("wrote rank.train rank.test (+ .query files)")

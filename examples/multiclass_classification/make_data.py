@@ -1,0 +1,17 @@
+"""Generate multiclass.train / multiclass.test (5 classes, 20 features)."""
+import numpy as np
+
+rng = np.random.RandomState(13)
+
+
+def make(n, path):
+    X = rng.randn(n, 20).astype(np.float32)
+    centers = rng.randn(5, 20) * 1.5
+    logits = X @ centers.T + 0.5 * rng.randn(n, 5)
+    y = logits.argmax(axis=1)
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+
+
+make(6000, "multiclass.train")
+make(500, "multiclass.test")
+print("wrote multiclass.train multiclass.test")

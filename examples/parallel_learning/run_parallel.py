@@ -1,0 +1,28 @@
+"""Train the same model under each mesh tree_learner; compare AUC."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+# demo runs on 8 VIRTUAL cpu devices so it works on any machine; on a
+# real TPU pod slice, drop these two lines and the mesh uses the chips
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+learner = sys.argv[1] if len(sys.argv) > 1 else "data"
+rng = np.random.RandomState(3)
+X = rng.randn(20000, 10).astype(np.float32)
+y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+
+bst = lgb.train({"objective": "binary", "metric": "auc",
+                 "tree_learner": learner, "top_k": 20, "verbosity": -1},
+                lgb.Dataset(X, label=y), num_boost_round=20)
+from sklearn.metrics import roc_auc_score
+print("%s-parallel on %d devices: train AUC %.4f"
+      % (learner, len(jax.devices()), roc_auc_score(y, bst.predict(X))))

@@ -1,0 +1,35 @@
+"""Custom objective + feval, cv, continued training, SHAP."""
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(4000, 6).astype(np.float32)
+y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+train = lgb.Dataset(X, label=y, free_raw_data=False)
+
+
+def logloss_obj(preds, dataset):
+    labels = dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return p - labels, p * (1.0 - p)
+
+
+def binary_error(preds, dataset):
+    labels = dataset.get_label()
+    return "error", float(np.mean((preds > 0) != (labels > 0.5))), False
+
+
+res = lgb.cv({"num_leaves": 15, "verbosity": -1}, train, num_boost_round=20,
+             nfold=3, fobj=logloss_obj, feval=binary_error)
+print("cv error (last):", res["valid error-mean"][-1])
+
+bst = lgb.train({"objective": "binary", "verbosity": -1}, train,
+                num_boost_round=10)
+bst2 = lgb.train({"objective": "binary", "verbosity": -1}, train,
+                 num_boost_round=10, init_model=bst)   # continue training
+print("total trees after continuation:", bst2.num_trees())
+
+contrib = bst2.predict(X[:3], pred_contrib=True)
+print("SHAP row sums ~= raw scores:",
+      np.allclose(contrib.sum(axis=1),
+                  bst2.predict(X[:3], raw_score=True), atol=1e-4))

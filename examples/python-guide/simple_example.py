@@ -1,0 +1,21 @@
+"""Train / validate / save / load with the core API."""
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(5000, 10).astype(np.float32)
+y = (X[:, 0] + np.sin(X[:, 1] * 2) > 0).astype(np.float32)
+Xv, yv = X[4000:], y[4000:]
+
+train = lgb.Dataset(X[:4000], label=y[:4000])
+valid = train.create_valid(Xv, label=yv)
+
+evals = {}
+bst = lgb.train({"objective": "binary", "metric": ["auc", "binary_logloss"],
+                 "num_leaves": 31, "verbosity": -1},
+                train, num_boost_round=50, valid_sets=[valid],
+                early_stopping_rounds=10, evals_result=evals)
+print("best iteration:", bst.best_iteration)
+bst.save_model("model.txt", num_iteration=bst.best_iteration)
+loaded = lgb.Booster(model_file="model.txt")
+print("valid predictions:", loaded.predict(Xv)[:5])

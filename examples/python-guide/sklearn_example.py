@@ -1,0 +1,17 @@
+"""The sklearn estimator surface."""
+import numpy as np
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(4000, 8)
+y = 2.0 * X[:, 0] + X[:, 1] * X[:, 2] + 0.1 * rng.randn(4000)
+X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=1)
+
+model = lgb.LGBMRegressor(n_estimators=60, num_leaves=31,
+                          learning_rate=0.08)
+model.fit(X_tr, y_tr, eval_set=[(X_te, y_te)], eval_metric="l2",
+          early_stopping_rounds=8, verbose=False)
+print("R^2:", model.score(X_te, y_te))
+print("top features:", np.argsort(model.feature_importances_)[::-1][:3])

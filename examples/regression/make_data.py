@@ -1,0 +1,16 @@
+"""Generate regression.train / regression.test (label + 10 features)."""
+import numpy as np
+
+rng = np.random.RandomState(11)
+
+
+def make(n, path):
+    X = rng.randn(n, 10).astype(np.float32)
+    y = (2.0 * X[:, 0] + np.sin(X[:, 1] * 2) + X[:, 2] * X[:, 3]
+         + 0.1 * rng.randn(n))
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+
+
+make(7000, "regression.train")
+make(500, "regression.test")
+print("wrote regression.train regression.test")

@@ -318,6 +318,20 @@ class _InnerPredictor:
             X, num_iteration=self.num_iteration
             if self.num_iteration > 0 else None, raw_score=True)
 
+    def models(self):
+        """The init model's HostTrees — capped exactly like predict_raw
+        (explicit num_iteration, else best_iteration, else all), so the
+        merged trees always match the init scores training was seeded
+        from."""
+        all_models = self.booster._impl.models
+        eff = self.num_iteration
+        if eff <= 0:
+            eff = self.booster.best_iteration
+        if eff <= 0:
+            return all_models
+        k = max(self.booster._impl.num_tree_per_iteration, 1)
+        return all_models[:eff * k]
+
 
 class Booster:
     """Booster in LightGBM (basic.py:1578)."""
@@ -381,6 +395,22 @@ class Booster:
 
         self._impl = create_boosting(self.config, binned, self._objective,
                                      train_metrics)
+        if train_set._predictor is not None:
+            # the returned booster must be self-contained: prepend the init
+            # model's trees (LGBM_BoosterMerge -> GBDT::MergeFrom,
+            # gbdt.h:53); deep copies so later shrink/rollback cannot
+            # mutate the init booster
+            init_models = train_set._predictor.models()
+            init_k = max(train_set._predictor.booster._impl
+                         .num_tree_per_iteration, 1)
+            check(init_k == max(self._impl.num_tree_per_iteration, 1),
+                  "init model has %d trees per iteration but the new "
+                  "parameters produce %d" % (
+                      init_k, max(self._impl.num_tree_per_iteration, 1)))
+            self._impl._models = copy.deepcopy(init_models)
+            self._impl.num_init_iteration = (
+                len(init_models) // max(self._impl.num_tree_per_iteration, 1))
+            self._impl.iter_ = self._impl.num_init_iteration
         self.train_set_name = "training"
 
     def _init_from_string(self, model_str: str) -> None:

@@ -318,10 +318,15 @@ class GBDT:
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
-            # largest pack_partner the layout recorded
+            # largest pack_partner the layout recorded, and the packed
+            # subset is wherever a mod was recorded
             pack_j=int(np.asarray(self.feature_meta.pack_partner).max()
                        if self.feature_meta.pack_partner is not None
-                       and self.feature_meta.pack_partner.size else 1))
+                       and self.feature_meta.pack_partner.size else 1),
+            packed_features=tuple(
+                int(i) for i in np.nonzero(
+                    np.asarray(self.feature_meta.pack_mod))[0])
+            if self.feature_meta.pack_mod is not None else ())
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -363,10 +368,21 @@ class GBDT:
                 init = isc.reshape(k, ds.num_data).T.copy()
             else:
                 init = np.tile(isc.reshape(-1, 1), (1, k))
-        self._valid_pred_cache[len(self.valid_data) - 1] = {
+        cache = {
             "xb": jnp.asarray(ds.X_binned),
             "scores": jnp.asarray(init),
         }
+        self._valid_pred_cache[len(self.valid_data) - 1] = cache
+        self._materialize()
+        if self._models and ds.metadata.init_score is None:
+            # continued training: valid scores must include the merged init
+            # model's trees (score_updater.hpp:32-51). Binned replay works
+            # for matrix- and file-backed valid sets alike.
+            for i, ht in enumerate(self._models):
+                c = i % k
+                leaf = self._replay_leaves_binned(ht, cache["xb"])
+                cache["scores"] = cache["scores"].at[:, c].add(
+                    jnp.asarray(ht.leaf_value.astype(np.float32))[leaf])
 
     # ------------------------------------------------------------ training
     def _boost_from_average(self) -> None:

@@ -69,7 +69,9 @@ class GrowParams(NamedTuple):
     num_feat_bins: int = 0
     # joint-coded pair packing: max marginalization width (the largest
     # pack_partner; 1 = no packed columns, expand() stays a pure gather)
+    # and the static tuple of packed inner-feature indices
     pack_j: int = 1
+    packed_features: tuple = ()
     # forced splits (serial_tree_learner.cpp ForceSplits :593-751): the
     # first `num_forced` loop steps split a BFS-predetermined (leaf,
     # feature, threshold) instead of the best-gain candidate
@@ -302,26 +304,28 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         flat = hist.reshape(ncols * b, 3)
         bidx = jnp.arange(bf, dtype=jnp.int32)[None, :]          # [1, Bf]
         in_feat = bidx < meta.num_bin[:, None]                   # [F, Bf]
-        if params.pack_j > 1:
-            # generalized gather-sum: unpacked features use stride-1 bins
-            # with a single j term; packed ones marginalize over j
-            packed = meta.pack_mod[:, None, None] > 0            # [F, 1, 1]
-            bstride = jnp.where(packed[..., 0, 0], meta.pack_div, 1)
-            jstride = jnp.where(meta.pack_div > 1, 1,
-                                jnp.maximum(meta.pack_mod, 1))
-            jcount = jnp.where(packed[..., 0, 0], meta.pack_partner, 1)
+        idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
+        out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
+            * in_feat[..., None]
+        if params.packed_features:
+            # joint-coded pairs: overwrite just the packed features' rows
+            # with marginals of their column's joint histogram — a [P, Bf,
+            # J] gather-sum over the (static) packed subset, so unpacked
+            # features never pay for the marginalization width
+            pf = jnp.asarray(params.packed_features, jnp.int32)  # [P]
+            jstride = jnp.where(meta.pack_div[pf] > 1, 1,
+                                jnp.maximum(meta.pack_mod[pf], 1))
             jj = jnp.arange(params.pack_j, dtype=jnp.int32)[None, None, :]
-            idx = (meta.col[:, None, None] * b + meta.offset[:, None, None]
-                   + bidx[..., None] * bstride[:, None, None]
-                   + jj * jstride[:, None, None])                # [F, Bf, J]
-            ok = (jj < jcount[:, None, None]) & in_feat[..., None]
-            out = jnp.sum(
-                jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0)
-                * ok[..., None], axis=2)                         # [F, Bf, 3]
-        else:
-            idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
-            out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
-                * in_feat[..., None]
+            bidx_p = jnp.arange(bf, dtype=jnp.int32)[None, :, None]
+            idx_p = (meta.col[pf][:, None, None] * b
+                     + bidx_p * meta.pack_div[pf][:, None, None]
+                     + jj * jstride[:, None, None])              # [P, Bf, J]
+            ok = (jj < meta.pack_partner[pf][:, None, None]) \
+                & (bidx_p < meta.num_bin[pf][:, None, None])
+            out_p = jnp.sum(
+                jnp.take(flat, jnp.clip(idx_p, 0, ncols * b - 1), axis=0)
+                * ok[..., None], axis=2)                         # [P, Bf, 3]
+            out = out.at[pf].set(out_p)
         totals = jnp.stack([sum_g, sum_h, cnt])                  # [3]
         is_def = bidx == meta.default_bin[:, None]               # [F, Bf]
         sum_wo_def = jnp.sum(jnp.where(is_def[..., None], 0.0, out), axis=1)

@@ -474,7 +474,10 @@ class BinnedDataset:
             nb_a = self.bin_mappers[ja].num_bin
             nb_b = self.bin_mappers[jb].num_bin
             if nb_a * nb_b > b_max:
-                break                # widest pair no longer fits
+                # the widest can pair with no one (cb is the narrowest);
+                # drop it and keep pairing the rest
+                cand.append(cb)
+                continue
             self.col_features[ca] = [ja, jb]
             self.col_offsets[ca] = [0, 0]
             self.col_num_bin[ca] = nb_a * nb_b

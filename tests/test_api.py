@@ -104,9 +104,13 @@ def test_continue_training_from_init_model(tmp_path):
     train2 = lgb.Dataset(X, label=y, free_raw_data=False)
     bst2 = lgb.train({"objective": "regression", "verbosity": -1}, train2,
                      num_boost_round=5, init_model=bst1)
-    mse2 = float(np.mean(
-        (bst2.predict(X) + bst1.predict(X) - y) ** 2))
+    # the returned booster is self-contained: init trees are merged in
+    # (LGBM_BoosterMerge -> GBDT::MergeFrom), so it predicts alone
+    assert bst2.num_trees() == 10
+    mse2 = float(np.mean((bst2.predict(X) - y) ** 2))
     assert mse2 < mse1
+    # and the original booster is untouched by the continuation
+    assert bst1.num_trees() == 5
 
 
 def test_cv():

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Generate docs/Parameters.md from the config table.
+
+The reference generates src/io/config_auto.cpp FROM docs/Parameters.rst
+(doc-is-source-of-truth); here the direction is inverted — config.py's
+typed table is the source of truth and the doc is derived, so the two can
+never drift. Run: python tools/gen_params_doc.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.config import _PARAMS  # noqa: E402
+
+HEADER = """# Parameters
+
+Generated from `lightgbm_tpu/config.py` by `tools/gen_params_doc.py` —
+do not edit by hand. Keys and aliases follow the reference's parameter
+table (include/LightGBM/config.h); values are parsed from Python dicts,
+CLI `key=value` pairs, and `#`-commented config files alike.
+
+| Parameter | Type | Default | Aliases |
+|---|---|---|---|
+"""
+
+
+def main() -> None:
+    rows = []
+    for name, typ, default, aliases in _PARAMS:
+        tname = getattr(typ, "__name__", str(typ))
+        dflt = repr(default) if default != "" else "`\"\"`"
+        rows.append("| `%s` | %s | %s | %s |" % (
+            name, tname, dflt,
+            ", ".join("`%s`" % a for a in aliases) if aliases else "—"))
+    out = HEADER + "\n".join(rows) + "\n"
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "Parameters.md")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(out)
+    print("wrote %s (%d parameters)" % (os.path.normpath(path), len(rows)))
+
+
+if __name__ == "__main__":
+    main()
