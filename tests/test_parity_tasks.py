@@ -1,0 +1,150 @@
+"""Golden parity vs the reference for multiclass, lambdarank, and
+regression (extends tests/test_parity.py's binary coverage).
+
+Artifacts in tests/golden/ were produced by the reference CLI (v2.2.4,
+num_threads=1) on its own example datasets with:
+  num_trees=10 learning_rate=0.1 num_leaves=31 max_bin=255
+  min_data_in_leaf=20
+- *_model_ref.txt : reference-written model files
+- *_pred_ref.txt  : reference predictions on the example test sets
+- *_traj.txt      : per-iteration train/valid metric log lines
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.parser import parse_file
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+EXAMPLES = "/root/reference/examples"
+
+def needs_ref_data(task, fname):
+    return pytest.mark.skipif(
+        not os.path.exists(os.path.join(EXAMPLES, task, fname)),
+        reason="reference %s example data not available" % task)
+
+
+needs_multiclass = needs_ref_data("multiclass_classification",
+                                  "multiclass.train")
+needs_rank = needs_ref_data("lambdarank", "rank.train")
+needs_regression = needs_ref_data("regression", "regression.train")
+
+
+def _traj(name):
+    """Parse '[LightGBM] [Info] Iteration:N, <set> <metric> : v' lines."""
+    out = {}
+    pat = re.compile(r"Iteration:(\d+), (\S+) (\S+) : ([-\d.eE]+)")
+    for line in open(os.path.join(GOLDEN, name)):
+        m = pat.search(line)
+        if m:
+            it, ds, metric, v = m.groups()
+            out.setdefault(ds, {}).setdefault(metric, []).append(float(v))
+    return out
+
+
+def _load(task, name, label_column="0"):
+    return parse_file(os.path.join(EXAMPLES, task, name), has_header=False,
+                      label_column=label_column)
+
+
+@needs_multiclass
+def test_multiclass_reference_model_predicts_identically():
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN,
+                                              "multiclass_model_ref.txt"))
+    X, _, _ = _load("multiclass_classification", "multiclass.test")
+    prob = bst.predict(X)
+    golden = np.loadtxt(os.path.join(GOLDEN, "multiclass_pred_ref.txt"))
+    assert prob.shape == golden.shape
+    assert np.abs(prob - golden).max() < 1e-6
+
+
+@needs_multiclass
+def test_multiclass_trajectory_matches_reference():
+    X, y, _ = _load("multiclass_classification", "multiclass.train")
+    Xv, yv, _ = _load("multiclass_classification", "multiclass.test")
+    dtr = lgb.Dataset(X, y)
+    ev = {}
+    lgb.train({"objective": "multiclass", "num_class": 5,
+               "metric": "multi_logloss", "num_leaves": 31,
+               "learning_rate": 0.1, "max_bin": 255,
+               "min_data_in_leaf": 20, "verbosity": -1},
+              dtr, num_boost_round=10,
+              valid_sets=[dtr, lgb.Dataset(Xv, yv, reference=dtr)],
+              valid_names=["training", "valid_1"], evals_result=ev,
+              verbose_eval=False)
+    ref = _traj("multiclass_traj.txt")
+    ours = ev["training"]["multi_logloss"]
+    theirs = ref["training"]["multi_logloss"]
+    assert len(ours) == len(theirs)
+    assert np.abs(np.asarray(ours) - np.asarray(theirs)).max() < 2e-3
+    ours_v = ev["valid_1"]["multi_logloss"]
+    theirs_v = ref["valid_1"]["multi_logloss"]
+    assert np.abs(np.asarray(ours_v) - np.asarray(theirs_v)).max() < 3e-3
+
+
+@needs_rank
+def test_lambdarank_reference_model_predicts_identically():
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, "rank_model_ref.txt"))
+    X, _, _ = _load("lambdarank", "rank.test")
+    raw = bst.predict(X, raw_score=True)
+    golden = np.loadtxt(os.path.join(GOLDEN, "rank_pred_ref.txt"))
+    assert np.abs(raw - golden).max() < 1e-6
+
+
+@needs_rank
+def test_lambdarank_trajectory_matches_reference():
+    """NDCG@{1,3,5} per iteration within tolerance (lambdarank gradients,
+    query handling, and the DCG tables all pinned at once)."""
+    train_path = os.path.join(EXAMPLES, "lambdarank", "rank.train")
+    test_path = os.path.join(EXAMPLES, "lambdarank", "rank.test")
+    dtr = lgb.Dataset(train_path)
+    ev = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "ndcg_eval_at": [1, 3, 5], "num_leaves": 31,
+               "learning_rate": 0.1, "max_bin": 255,
+               "min_data_in_leaf": 20, "verbosity": -1},
+              dtr, num_boost_round=10,
+              valid_sets=[dtr, lgb.Dataset(test_path, reference=dtr)],
+              valid_names=["training", "valid_1"], evals_result=ev,
+              verbose_eval=False)
+    ref = _traj("rank_traj.txt")
+    for ds in ("training", "valid_1"):
+        for k in (1, 3, 5):
+            ours = np.asarray(ev[ds]["ndcg@%d" % k])
+            theirs = np.asarray(ref[ds]["ndcg@%d" % k])
+            assert len(ours) == len(theirs)
+            assert np.abs(ours - theirs).max() < 5e-3, (ds, k, ours, theirs)
+
+
+@needs_regression
+def test_regression_reference_model_predicts_identically():
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN,
+                                              "regression_model_ref.txt"))
+    X, _, _ = _load("regression", "regression.test")
+    pred = bst.predict(X)
+    golden = np.loadtxt(os.path.join(GOLDEN, "regression_pred_ref.txt"))
+    assert np.abs(pred - golden).max() < 1e-6
+
+
+@needs_regression
+def test_regression_trajectory_matches_reference():
+    dtr = lgb.Dataset(os.path.join(EXAMPLES, "regression",
+                                   "regression.train"))
+    dv = lgb.Dataset(os.path.join(EXAMPLES, "regression",
+                                  "regression.test"), reference=dtr)
+    ev = {}
+    lgb.train({"objective": "regression", "metric": "l2", "num_leaves": 31,
+               "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 20,
+               "verbosity": -1},
+              dtr, num_boost_round=10, valid_sets=[dtr, dv],
+              valid_names=["training", "valid_1"], evals_result=ev,
+              verbose_eval=False)
+    ref = _traj("regression_traj.txt")
+    for ds in ("training", "valid_1"):
+        ours = np.asarray(ev[ds]["l2"])
+        theirs = np.asarray(ref[ds]["l2"])
+        assert len(ours) == len(theirs)
+        assert np.abs(ours - theirs).max() / max(theirs.max(), 1.0) < 1e-3, ds
