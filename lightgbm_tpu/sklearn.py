@@ -182,6 +182,8 @@ class LGBMModel(BaseEstimator):
                 if isinstance(self.random_state, int) else 0
         if eval_metric is not None and not callable(eval_metric):
             params["metric"] = eval_metric
+        if getattr(self, "_fit_eval_at", None):
+            params["ndcg_eval_at"] = self._fit_eval_at
         feval = _EvalFunctionWrapper(eval_metric) if callable(eval_metric) \
             else None
 
@@ -350,11 +352,20 @@ class LGBMRanker(LGBMModel):
     def _default_objective(self) -> str:
         return "lambdarank"
 
-    def fit(self, X, y, group=None, eval_set=None, eval_group=None, **kwargs):
+    def fit(self, X, y, group=None, eval_set=None, eval_group=None,
+            eval_at=None, **kwargs):
         if group is None:
             raise ValueError("Should set group for ranking task")
         if eval_set is not None and eval_group is None:
             raise ValueError("Eval_group cannot be None when eval_set is not "
                              "None")
-        return super().fit(X, y, group=group, eval_set=eval_set,
-                           eval_group=eval_group, **kwargs)
+        # NDCG/MAP truncation levels (sklearn.py:880): fit-local only — the
+        # estimator's constructor params must not change across fit calls,
+        # and an explicit constructor ndcg_eval_at wins when eval_at is
+        # not passed (config's own default covers the rest)
+        self._fit_eval_at = list(eval_at) if eval_at is not None else None
+        try:
+            return super().fit(X, y, group=group, eval_set=eval_set,
+                               eval_group=eval_group, **kwargs)
+        finally:
+            self._fit_eval_at = None

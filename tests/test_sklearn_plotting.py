@@ -1,0 +1,83 @@
+"""sklearn estimator surface (LGBMRegressor/Classifier/Ranker) and the
+plotting helpers (plot_importance/metric/tree) — reference python-package
+sklearn.py / plotting.py parity by function."""
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+
+def _xy(n=1500, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(int)
+    return X, y
+
+
+def test_classifier_fit_predict_proba():
+    X, y = _xy()
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=15)
+    clf.fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    assert roc_auc_score(y, proba[:, 1]) > 0.95
+    assert set(clf.predict(X)) <= {0, 1}
+    assert list(clf.classes_) == [0, 1]
+
+
+def test_regressor_early_stopping_sets_best_iteration():
+    X, y = _xy()
+    yr = X[:, 0] * 2 + 0.05 * np.random.RandomState(1).randn(len(y))
+    reg = lgb.LGBMRegressor(n_estimators=200, learning_rate=0.3)
+    reg.fit(X[:1000], yr[:1000], eval_set=[(X[1000:], yr[1000:])],
+            eval_metric="l2", early_stopping_rounds=5, verbose=False)
+    assert reg.best_iteration_ is not None
+    assert reg.best_iteration_ < 200
+
+
+def test_ranker_fit_with_groups():
+    rng = np.random.RandomState(3)
+    groups = [20] * 40
+    n = sum(groups)
+    X = rng.randn(n, 8)
+    rel = X[:, 0] + 0.5 * X[:, 1]
+    y = np.clip(np.digitize(rel, [-0.5, 0.5, 1.2]), 0, 3)
+    rk = lgb.LGBMRanker(n_estimators=10, num_leaves=15)
+    rk.fit(X, y, group=groups, eval_set=[(X, y)], eval_group=[groups],
+           eval_at=[3], verbose=False)
+    scores = rk.predict(X)
+    assert scores.shape == (n,)
+    # scores must rank high-relevance rows above low within queries
+    top = scores[y == 3].mean()
+    bot = scores[y == 0].mean()
+    assert top > bot
+
+
+def test_plot_importance_and_metric():
+    import matplotlib
+    matplotlib.use("Agg")
+    X, y = _xy()
+    ev = {}
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "metric": "auc",
+                     "verbosity": -1}, train, num_boost_round=8,
+                    valid_sets=[train], valid_names=["training"],
+                    evals_result=ev, verbose_eval=False)
+    ax = lgb.plot_importance(bst)
+    assert ax is not None
+    ax2 = lgb.plot_metric(ev, metric="auc")
+    assert ax2 is not None
+
+
+@pytest.mark.skipif(__import__("shutil").which("dot") is None,
+                    reason="graphviz 'dot' executable not installed")
+def test_plot_tree_renders():
+    import matplotlib
+    matplotlib.use("Agg")
+    X, y = _xy()
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    ax = lgb.plot_tree(bst, tree_index=1)
+    assert ax is not None
