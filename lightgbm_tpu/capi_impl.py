@@ -73,18 +73,29 @@ def dataset_from_mat(mv: memoryview, dtype_code: int, nrow: int, ncol: int,
     return ds
 
 
+def _csr_parts(indptr_mv, indptr_code, indices_mv, data_mv, data_code,
+               nindptr, nelem):
+    """Copy CSR pieces out of caller-owned memory (the host may free its
+    buffers on return); nelem == 0 (all-zero rows) is a valid matrix."""
+    indptr = np.frombuffer(indptr_mv, dtype=_DTYPES[indptr_code],
+                           count=nindptr).copy()
+    if nelem == 0:
+        return indptr, np.zeros(0, np.int32), np.zeros(0, np.float64)
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem).copy()
+    vals = np.frombuffer(data_mv, dtype=_DTYPES[data_code],
+                         count=nelem).copy()
+    return indptr, indices, vals
+
+
 def dataset_from_csr(indptr_mv: memoryview, indptr_code: int,
                      indices_mv: memoryview, data_mv: memoryview,
                      data_code: int, nindptr: int, nelem: int,
                      num_col: int, params: str,
                      reference: Optional[Dataset]) -> Dataset:
     from scipy.sparse import csr_matrix
-    # copy out of caller-owned memory (the host may free it on return)
-    indptr = np.frombuffer(indptr_mv, dtype=_DTYPES[indptr_code],
-                           count=nindptr).copy()
-    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem).copy()
-    vals = np.frombuffer(data_mv, dtype=_DTYPES[data_code],
-                         count=nelem).copy()
+    indptr, indices, vals = _csr_parts(
+        indptr_mv, indptr_code, indices_mv, data_mv, data_code, nindptr,
+        nelem)
     mat = csr_matrix((vals, indices, indptr),
                      shape=(nindptr - 1, num_col))
     return Dataset(mat, reference=reference, params=parse_params(params),
@@ -167,6 +178,20 @@ def booster_num_total_model(bst: Booster) -> int:
     return int(bst.num_trees())
 
 
+def booster_merge(dst: Booster, src: Booster) -> None:
+    """GBDT::MergeFrom (gbdt.h:53-64): src's trees go FIRST (deep copies),
+    dst's own trees follow; num_init_iteration tracks the prefix."""
+    import copy as _copy
+    k = max(dst._impl.num_tree_per_iteration, 1)
+    if max(src._impl.num_tree_per_iteration, 1) != k:
+        raise LightGBMError("cannot merge boosters with different "
+                            "trees-per-iteration")
+    merged = _copy.deepcopy(src._impl.models) + list(dst._impl.models)
+    dst._impl.models = merged
+    dst._impl.num_init_iteration = len(src._impl.models) // k
+    dst._impl.iter_ = len(merged) // k
+
+
 def booster_eval(bst: Booster, data_idx: int) -> bytes:
     if data_idx == 0:
         res = bst.eval_train()
@@ -183,12 +208,10 @@ def booster_eval_names(bst: Booster) -> List[str]:
     return names
 
 
-def booster_predict_mat(bst: Booster, mv: memoryview, dtype_code: int,
-                        nrow: int, ncol: int, row_major: int,
-                        predict_type: int, num_iteration: int,
-                        parameter: str) -> bytes:
-    data = _mat(mv, dtype_code, nrow, ncol, row_major)
-    p = parse_params(parameter)
+def _predict_kwargs(predict_type: int, num_iteration: int,
+                    parameter: str) -> Dict:
+    """One predict-kwargs builder for every prediction entry point, so
+    the mat/CSR paths cannot drift."""
     kw = dict(num_iteration=(num_iteration if num_iteration > 0 else None))
     if predict_type == 1:
         kw["raw_score"] = True
@@ -196,8 +219,41 @@ def booster_predict_mat(bst: Booster, mv: memoryview, dtype_code: int,
         kw["pred_leaf"] = True
     elif predict_type == 3:
         kw["pred_contrib"] = True
+    p = parse_params(parameter)
     if "pred_early_stop" in p:
         kw["pred_early_stop"] = p["pred_early_stop"] in ("true", "1")
+    return kw
+
+
+def booster_predict_csr(bst: Booster, indptr_mv: memoryview,
+                        indptr_code: int, indices_mv: memoryview,
+                        data_mv: memoryview, data_code: int, nindptr: int,
+                        nelem: int, num_col: int, predict_type: int,
+                        num_iteration: int, parameter: str) -> bytes:
+    from scipy.sparse import csr_matrix
+    indptr, indices, vals = _csr_parts(
+        indptr_mv, indptr_code, indices_mv, data_mv, data_code, nindptr,
+        nelem)
+    mat = csr_matrix((vals, indices, indptr), shape=(nindptr - 1, num_col))
+    kw = _predict_kwargs(predict_type, num_iteration, parameter)
+    # densify in row blocks so a large sparse batch never materializes as
+    # one dense matrix (the reference streams CSR rows)
+    block = max(1, 1 << 24 >> max(num_col, 1).bit_length())
+    outs = []
+    for lo in range(0, mat.shape[0], block):
+        dense = mat[lo:lo + block].toarray().astype(np.float64, copy=False)
+        outs.append(np.asarray(bst.predict(dense, **kw), np.float64))
+    if not outs:
+        return b""
+    return np.concatenate(outs).tobytes()
+
+
+def booster_predict_mat(bst: Booster, mv: memoryview, dtype_code: int,
+                        nrow: int, ncol: int, row_major: int,
+                        predict_type: int, num_iteration: int,
+                        parameter: str) -> bytes:
+    data = _mat(mv, dtype_code, nrow, ncol, row_major)
+    kw = _predict_kwargs(predict_type, num_iteration, parameter)
     out = np.asarray(bst.predict(np.ascontiguousarray(data, np.float64),
                                  **kw), np.float64)
     return out.tobytes()

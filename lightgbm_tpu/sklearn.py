@@ -183,6 +183,11 @@ class LGBMModel(BaseEstimator):
         if eval_metric is not None and not callable(eval_metric):
             params["metric"] = eval_metric
         if getattr(self, "_fit_eval_at", None):
+            # drop every alias so the fit-time value cannot lose the
+            # Config alias-resolution race against a constructor param
+            for alias in ("eval_at", "ndcg_eval_at", "ndcg_at",
+                          "map_eval_at", "map_at"):
+                params.pop(alias, None)
             params["ndcg_eval_at"] = self._fit_eval_at
         feval = _EvalFunctionWrapper(eval_metric) if callable(eval_metric) \
             else None

@@ -73,6 +73,7 @@ int LGBM_BoosterLoadModelFromString(const char* model_str,
                                     int* out_num_iterations,
                                     BoosterHandle* out);
 int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
 int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data);
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
@@ -90,6 +91,13 @@ int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
                              char** out_strs);
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
                         double* out_results);
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
 int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int data_type, int32_t nrow, int32_t ncol,
                               int is_row_major, int predict_type,

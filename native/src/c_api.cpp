@@ -333,6 +333,43 @@ int LGBM_BoosterFree(BoosterHandle handle) {
   API_END();
 }
 
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_merge",
+      Py_BuildValue("(OO)", reinterpret_cast<PyObject*>(handle),
+                    reinterpret_cast<PyObject*>(other_handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_csr",
+      Py_BuildValue("(ONiNNiLLLiis)",
+                    reinterpret_cast<PyObject*>(handle),
+                    mv_from(indptr, nindptr * dtype_size(indptr_type)),
+                    indptr_type, mv_from(indices, nelem * 4),
+                    mv_from(data, nelem * dtype_size(data_type)), data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col), predict_type,
+                    num_iteration, parameter ? parameter : ""));
+  if (r == nullptr) return -1;
+  int rc = copy_bytes_out(r, out_result, out_len);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
 int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data) {
   API_BEGIN();

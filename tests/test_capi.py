@@ -101,3 +101,63 @@ def test_error_reporting(lib_path):
         ctypes.byref(out))
     assert rc == -1
     assert b"" != lib.LGBM_GetLastError()
+
+
+def test_merge_and_csr_predict(lib_path):
+    """LGBM_BoosterMerge prepends the other booster's trees (MergeFrom);
+    LGBM_BoosterPredictForCSR predicts from sparse rows."""
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def make_booster():
+        ds = ctypes.c_void_p()
+        assert lib.LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), 1, 400, 4, 1, b"",
+            None, ctypes.byref(ds)) == 0
+        assert lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 400, 0) == 0
+        bst = ctypes.c_void_p()
+        assert lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)) == 0
+        fin = ctypes.c_int(0)
+        for _ in range(3):
+            assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+        return ds, bst
+
+    ds1, b1 = make_booster()
+    ds2, b2 = make_booster()
+    n1 = ctypes.c_int(0)
+    assert lib.LGBM_BoosterMerge(b1, b2) == 0, lib.LGBM_GetLastError()
+    assert lib.LGBM_BoosterNumberOfTotalModel(b1, ctypes.byref(n1)) == 0
+    assert n1.value == 6
+
+    from scipy.sparse import csr_matrix
+    S = csr_matrix(X[:50])
+    indptr = S.indptr.astype(np.int32)
+    out_len = ctypes.c_int64(0)
+    preds = np.zeros(50, np.float64)
+    lib.LGBM_BoosterPredictForCSR.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double)]
+    rc = lib.LGBM_BoosterPredictForCSR(
+        b1, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        S.indices.astype(np.int32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)),
+        S.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        len(indptr), S.nnz, 4, 0, -1, b"", ctypes.byref(out_len),
+        preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == 50
+    assert 0.0 < preds.mean() < 1.0
+    for h in (b1, b2):
+        assert lib.LGBM_BoosterFree(h) == 0
+    for d in (ds1, ds2):
+        assert lib.LGBM_DatasetFree(d) == 0
