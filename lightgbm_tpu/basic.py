@@ -475,6 +475,30 @@ class Booster:
         grad, hess = fobj(self.__pred_for_fobj(), self._train_set)
         return self.__boost(grad, hess)
 
+    def __getstate__(self):
+        """Pickle as the model text (reference basic.py __getstate__
+        drops the native handle the same way): the unpickled booster
+        predicts and serializes; training state (datasets, device arrays,
+        compiled programs) intentionally does not survive."""
+        state = {
+            "params": self.params,
+            "best_iteration": self.best_iteration,
+            "best_score": dict(self.best_score),
+            "pandas_categorical": self.pandas_categorical,
+            "model_str": (self.model_to_string(num_iteration=-1)
+                          if self._impl is not None and self._impl.models
+                          else None),
+        }
+        return state
+
+    def __setstate__(self, state):
+        self.__init__(params=state.get("params"),
+                      model_str=state.get("model_str"))
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        if state.get("pandas_categorical") is not None:
+            self.pandas_categorical = state["pandas_categorical"]
+
     def __pred_for_fobj(self) -> np.ndarray:
         scores = np.array(self._impl.scores)
         return scores[:, 0] if scores.shape[1] == 1 else scores.reshape(-1, order="F")

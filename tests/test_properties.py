@@ -1,0 +1,134 @@
+"""Reference-scenario and property tests (SURVEY.md §4 implication (c):
+the invariants the reference only asserts under #ifdef DEBUG).
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.core.histogram import build_histogram, fix_histogram
+
+
+def test_booster_pickle_roundtrip():
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    bst.best_iteration = 3
+    clone = pickle.loads(pickle.dumps(bst))
+    np.testing.assert_array_equal(clone.predict(X), bst.predict(X))
+    assert clone.best_iteration == 3
+    # and the clone itself re-serializes
+    again = pickle.loads(pickle.dumps(clone))
+    np.testing.assert_array_equal(again.predict(X), bst.predict(X))
+
+
+def test_sklearn_estimator_pickle_roundtrip():
+    rng = np.random.RandomState(1)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=4, num_leaves=15)
+    clf.fit(X, y)
+    clone = pickle.loads(pickle.dumps(clf))
+    np.testing.assert_array_equal(clone.predict_proba(X),
+                                  clf.predict_proba(X))
+
+
+def test_non_contiguous_input():
+    """Sliced ndarray views train and predict (test_engine.py:630)."""
+    rng = np.random.RandomState(2)
+    Xbig = rng.randn(1200, 8)
+    y = (Xbig[:, 1] > 0).astype(float)
+    Xs = Xbig[::2, 1:6]                      # non-contiguous view
+    assert not Xs.flags["C_CONTIGUOUS"]
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(Xs, label=y[::2]), num_boost_round=3)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y[::2], bst.predict(Xs)) > 0.95
+
+
+def test_constant_features_dropped():
+    """Constant columns are trivial (test_engine.py:789-819): never split
+    on, and a fully-constant dataset still trains a constant model."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 3)
+    X[:, 1] = 7.0
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst.feature_importance()[1] == 0
+
+
+def test_get_split_value_histogram():
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 3)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    counts, edges = bst.get_split_value_histogram(0)
+    assert counts.sum() > 0
+    assert len(edges) == len(counts) + 1
+
+
+def test_histogram_subtraction_consistency():
+    """parent == left + right for any partition of the rows (the
+    FeatureHistogram::Subtract invariant)."""
+    rng = np.random.RandomState(5)
+    n, f, b = 5000, 6, 64
+    xb = jnp.asarray(rng.randint(0, b, (n, f)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(rng.rand(n).astype(np.float32))
+    left = (rng.rand(n) < 0.4).astype(np.float32)
+    parent = build_histogram(xb, g, h, jnp.ones(n, jnp.float32), b,
+                             impl="scatter")
+    hl = build_histogram(xb, g, h, jnp.asarray(left), b, impl="scatter")
+    hr = build_histogram(xb, g, h, jnp.asarray(1.0 - left), b,
+                         impl="scatter")
+    np.testing.assert_allclose(np.asarray(hl + hr), np.asarray(parent),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_fix_histogram_restores_totals():
+    """After fix_histogram the per-feature sums equal the exact leaf
+    totals (Dataset::FixHistogram, dataset.h:411-412)."""
+    rng = np.random.RandomState(6)
+    n, f, b = 2000, 4, 32
+    xb = jnp.asarray(rng.randint(0, b, (n, f)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(rng.rand(n).astype(np.float32))
+    hist = build_histogram(xb, g, h, jnp.ones(n, jnp.float32), b,
+                           impl="scatter")
+    # corrupt the default bin, then repair it from totals
+    default_bins = jnp.zeros(f, jnp.int32)
+    corrupted = hist.at[:, 0, :].add(7.0)
+    sum_g, sum_h = jnp.sum(g), jnp.sum(h)
+    fixed = fix_histogram(corrupted, default_bins, sum_g, sum_h,
+                          jnp.float32(n))
+    totals = np.asarray(fixed).sum(axis=1)                   # [F, 3]
+    np.testing.assert_allclose(totals[:, 0], float(sum_g), rtol=1e-4)
+    np.testing.assert_allclose(totals[:, 1], float(sum_h), rtol=1e-4)
+    np.testing.assert_allclose(totals[:, 2], float(n), rtol=1e-5)
+
+
+def test_partition_counts_match_split_info():
+    """Per-leaf row counts derived from the final leaf assignment equal
+    the counts the split search recorded (the reference's #ifdef DEBUG
+    CHECK, serial_tree_learner.cpp:820-822)."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(3000, 5).astype(np.float32)
+    y = (X[:, 0] + np.sin(X[:, 1] * 2) > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 31}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    leaves = bst.predict(X, pred_leaf=True)   # [N, num_trees]
+    for t_idx, ht in enumerate(bst._impl.models):
+        got = np.bincount(leaves[:, t_idx],
+                          minlength=ht.num_leaves_actual)
+        np.testing.assert_array_equal(
+            got[:ht.num_leaves_actual],
+            ht.leaf_count[:ht.num_leaves_actual])
