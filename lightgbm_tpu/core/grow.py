@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import build_histogram
-from .partition import RowPartition, hist_for_leaf, init_partition, split_leaf
+from .partition import (RowPartition, hist_for_leaf, init_partition,
+                        leaf_id_from_partition, split_leaf, stack_vals)
 from .split import (BestSplit, FeatureMeta, SplitParams, K_EPSILON,
                     K_MIN_SCORE, MISSING_NAN, MISSING_NONE, MISSING_ZERO,
                     calculate_leaf_output, find_best_split, leaf_split_gain,
@@ -281,6 +282,11 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
 
+    # CEGB's lazy acquisition accounting reads leaf_id during growth; only
+    # then is the per-split leaf_id scatter worth its cost — otherwise the
+    # assignment is reconstructed from the final ranges in one dense pass
+    maintain_lid = (cegb is not None and params.with_cegb_lazy)
+
     def hist_for_mask(mask_f32):
         h = build_histogram(xb, grad, hess, mask_f32, num_bins=b,
                             row_chunk=params.row_chunk, impl=params.hist_impl)
@@ -399,6 +405,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     # ---- root ------------------------------------------------------------
     sample_mask = sample_mask.astype(jnp.float32)
+    vals3 = stack_vals(grad, hess, sample_mask) if use_partition else None
     root_g = psum(jnp.sum(grad * sample_mask))
     root_h = psum(jnp.sum(hess * sample_mask))
     root_c = psum(jnp.sum(sample_mask))
@@ -451,9 +458,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         def rebuild(_):
             if use_partition:
-                return hist_for_leaf(s.part, leaf_idx, xb, grad, hess,
-                                     sample_mask, b, params.row_chunk,
-                                     valid=True, impl=params.hist_impl)
+                return hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
+                                     params.row_chunk, valid=True,
+                                     impl=params.hist_impl)
             m = (s.leaf_id == leaf_idx).astype(jnp.float32) * sample_mask
             return hist_for_mask(m)
 
@@ -573,7 +580,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     cur.is_categorical, cur.cat_bitset)
 
             part, leaf_id = split_leaf(s.part, s.leaf_id, leaf, right_leaf,
-                                       go_left_rows, valid, params.row_chunk)
+                                       go_left_rows, valid, params.row_chunk,
+                                       maintain_leaf_id=maintain_lid)
         else:
             part = s.part
             col = jnp.take(xb, stored_col, axis=1)
@@ -648,9 +656,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         if use_partition:
             # O(rows_in_leaf): gather only the smaller child's rows through
             # the partition (dead iterations have count 0 -> zero trips)
-            hist_small = hist_for_leaf(part, small_leaf, xb, grad, hess,
-                                       sample_mask, b, params.row_chunk,
-                                       valid=valid, impl=params.hist_impl)
+            hist_small = hist_for_leaf(part, small_leaf, xb, vals3, b,
+                                       params.row_chunk, valid=valid,
+                                       impl=params.hist_impl)
         elif axis_name is None:
             def live_hist(_):
                 m = (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
@@ -784,4 +792,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           pool_map=pool_map)
 
     state = lax.fori_loop(0, l - 1, step, state)
-    return state.tree, state.leaf_id, state.cegb
+    leaf_id_out = state.leaf_id
+    if use_partition and not maintain_lid:
+        leaf_id_out = leaf_id_from_partition(state.part, n, l)
+    return state.tree, leaf_id_out, state.cegb

@@ -55,16 +55,16 @@ def _hist_scatter(xb: jnp.ndarray, vals: jnp.ndarray, num_bins: int) -> jnp.ndar
     return hist.reshape(f, num_bins, vals.shape[-1])
 
 
-def hist_tile(xb_rows: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-              mask: jnp.ndarray, num_bins: int, impl: str) -> jnp.ndarray:
-    """One fixed-size row tile -> [F, B, 3]; dispatches on impl like
-    build_histogram. Used by the row-partition path (core/partition.py) whose
-    chunking is a data-dependent while_loop rather than a scan."""
+def hist_tile_vals(xb_rows: jnp.ndarray, vals: jnp.ndarray, num_bins: int,
+                   impl: str) -> jnp.ndarray:
+    """One fixed-size row tile with pre-stacked [rows, 3] values
+    (grad*mask, hess*mask, mask) -> [F, B, 3]. Used by the row-partition
+    path (core/partition.py), which gathers the stacked values in a single
+    indexed read per tile."""
     if impl in ("pallas", "pallas_interpret"):
-        from .histogram_pallas import build_histogram_pallas
-        return build_histogram_pallas(xb_rows, grad, hess, mask, num_bins,
-                                      interpret=(impl == "pallas_interpret"))
-    vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)
+        from .histogram_pallas import build_histogram_pallas_vals
+        return build_histogram_pallas_vals(
+            xb_rows, vals.T, num_bins, interpret=(impl == "pallas_interpret"))
     if impl == "scatter":
         return _hist_scatter(xb_rows, vals, num_bins)
     return _hist_chunk_matmul(xb_rows, vals, num_bins)

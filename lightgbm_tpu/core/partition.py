@@ -7,18 +7,30 @@ O(rows_in_leaf) instead of O(num_data) per split. The reference keeps
 partitions a leaf's range with per-thread counts + prefix sums; here the
 same invariant is maintained functionally:
 
-- ``order``   [N + chunk] int32 — row ids grouped by leaf (padded tail
-  entries point past N and are dropped by masked scatters).
+- ``order``   [N + chunk] int32 — row ids grouped by leaf (the padded tail
+  holds one trash slot that no leaf range ever covers).
 - ``leaf_begin`` / ``leaf_count`` [L] int32 — each leaf's contiguous range.
 
+Design notes from profiling on a v5e chip: inside a sequential growth loop,
+dynamic-indexed ops (gather/scatter) cost ~0.4-0.8 ms *each* in latency
+regardless of size up to ~64k elements, while dense full-array ops run at
+memory bandwidth. The layout below therefore minimizes the NUMBER of
+indexed ops per split rather than the elements they touch:
+
+- per-row values ride in one stacked [N, 3] f32 array (grad*mask, hess*mask,
+  mask), so a histogram trip does ONE row gather + ONE value gather;
+- every gather/scatter is annotated promise-in-bounds (indices are clamped
+  or routed to the trash slot first);
+- ``leaf_id`` is NOT maintained per split — it is reconstructed once per
+  tree from the final ranges (leaf_id_from_partition), replacing
+  O(N x depth) scattered writes with one dense searchsorted + one scatter.
+
 Both maintenance and consumption are chunked ``lax.while_loop``s whose trip
-count is data-dependent (ceil(count / chunk)), so the device work per split
-is proportional to the rows actually touched — the O(N x depth) total the
-reference achieves — while every tensor op inside the loop body has static
-shapes for XLA. The partition scatter fills the left child forward from the
-range start and the right child backward from the range end, so a single
-pass suffices (no count-then-scatter double pass; within-leaf row order is
-irrelevant to histogram sums).
+count is data-dependent (ceil(count / chunk)); with the default chunk most
+leaves take a single trip. The partition scatter fills the left child
+forward from the range start and the right child backward from the range
+end, so a single pass suffices (within-leaf row order is irrelevant to
+histogram sums).
 
 Histogram builds gather the leaf's rows through ``order`` (the analog of the
 reference's ordered-gradient gather, dataset.cpp ConstructHistograms) and
@@ -33,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .histogram import hist_tile
+from .histogram import hist_tile_vals
 
 
 class RowPartition(NamedTuple):
@@ -52,18 +64,31 @@ def init_partition(num_data: int, num_leaves: int, chunk: int) -> RowPartition:
     return RowPartition(order, leaf_begin, leaf_count)
 
 
-def split_leaf(part: RowPartition, leaf_id: jnp.ndarray, leaf, right_leaf,
-               go_left_fn, valid, chunk: int
+def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
+               mask: jnp.ndarray) -> jnp.ndarray:
+    """[N, 3] (grad*mask, hess*mask, mask) — one gather per histogram trip
+    instead of three (the ordered-gradients copy of the reference,
+    dataset.cpp ConstructHistograms)."""
+    m = mask.astype(jnp.float32)
+    return jnp.stack([grad * m, hess * m, m], axis=1)
+
+
+def split_leaf(part: RowPartition, leaf_id, leaf, right_leaf,
+               go_left_fn, valid, chunk: int, maintain_leaf_id: bool = False
                ) -> Tuple[RowPartition, jnp.ndarray]:
     """Partition ``leaf``'s range into (left: keeps ``leaf``) and (right:
-    becomes ``right_leaf``), updating per-row ``leaf_id`` along the way.
+    becomes ``right_leaf``).
 
     ``go_left_fn(row_idx) -> bool[chunk]`` evaluates the split decision for a
     chunk of row ids (the Tree::Split + DataPartition::Split pair). With
     ``valid`` false the loop body never runs and nothing changes.
+    ``leaf_id`` is only written when ``maintain_leaf_id`` (CEGB's lazy
+    acquisition accounting needs it live); otherwise use
+    leaf_id_from_partition after the tree is grown.
     """
     n_rows = leaf_id.shape[0]
     order_len = part.order.shape[0]
+    trash = order_len - 1                  # never inside any leaf range
     beg = part.leaf_begin[leaf]
     cnt = jnp.where(valid, part.leaf_count[leaf], 0)
 
@@ -83,12 +108,15 @@ def split_leaf(part: RowPartition, leaf_id: jnp.ndarray, leaf, right_leaf,
         lpos = beg + nl + (jnp.cumsum(is_l.astype(jnp.int32)) - is_l)
         rpos = beg + cnt - 1 - nr - (jnp.cumsum(is_r.astype(jnp.int32)) - is_r)
         pos = jnp.where(go_left, lpos, rpos)
-        pos = jnp.where(in_range, pos, order_len)        # OOB -> dropped
-        order_new = order_new.at[pos].set(idx, mode="drop")
-        idx_safe = jnp.where(in_range, idx, n_rows)      # OOB -> dropped
-        lid = lid.at[idx_safe].set(
-            jnp.where(go_left, leaf, right_leaf).astype(lid.dtype),
-            mode="drop")
+        pos = jnp.where(in_range, pos, trash)
+        order_new = order_new.at[pos].set(idx, mode="promise_in_bounds")
+        if maintain_leaf_id:
+            # max-scatter: right_leaf (= step + 1) exceeds every leaf id
+            # assigned so far, left rows keep their id, and padded/OOB
+            # duplicates contribute 0 — so duplicate writes commute
+            idx_safe = jnp.minimum(idx, n_rows - 1)
+            val = jnp.where(is_r, right_leaf, 0).astype(lid.dtype)
+            lid = lid.at[idx_safe].max(val, mode="promise_in_bounds")
         return (i + 1, nl + jnp.sum(is_l.astype(jnp.int32)),
                 nr + jnp.sum(is_r.astype(jnp.int32)), order_new, lid)
 
@@ -106,15 +134,15 @@ def split_leaf(part: RowPartition, leaf_id: jnp.ndarray, leaf, right_leaf,
 
 
 def hist_for_leaf(part: RowPartition, leaf, xb: jnp.ndarray,
-                  grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray,
-                  num_bins: int, chunk: int, valid=True,
+                  vals: jnp.ndarray, num_bins: int, chunk: int, valid=True,
                   impl: str = "matmul") -> jnp.ndarray:
     """Build [F, B, 3] (grad, hess, count) histograms over one leaf's rows.
 
     Touches ceil(leaf_count / chunk) fixed-size tiles: row ids come from a
-    contiguous slice of ``order``; feature bytes and gradients are gathered
-    per tile. ``mask`` carries bagging/GOSS inclusion.
+    contiguous slice of ``order``; feature bytes and the stacked [N, 3]
+    ``vals`` (see stack_vals) are gathered once per tile.
     """
+    n_rows = xb.shape[0]
     f = xb.shape[1]
     beg = part.leaf_begin[leaf]
     cnt = jnp.where(valid, part.leaf_count[leaf], 0)
@@ -129,13 +157,34 @@ def hist_for_leaf(part: RowPartition, leaf, xb: jnp.ndarray,
         idx = lax.dynamic_slice(part.order, (start,), (chunk,))
         j = jnp.arange(chunk, dtype=jnp.int32)
         in_range = (i * chunk + j) < cnt
-        idx_safe = jnp.where(in_range, idx, 0)
-        rows = jnp.take(xb, idx_safe, axis=0)            # [chunk, F]
-        m = jnp.take(mask, idx_safe) * in_range.astype(jnp.float32)
-        g = jnp.take(grad, idx_safe)
-        h = jnp.take(hess, idx_safe)
-        return i + 1, acc + hist_tile(rows, g, h, m, num_bins, impl)
+        idx_safe = jnp.minimum(jnp.where(in_range, idx, 0), n_rows - 1)
+        rows = xb.at[idx_safe].get(mode="promise_in_bounds")   # [chunk, F]
+        v = vals.at[idx_safe].get(mode="promise_in_bounds") \
+            * in_range[:, None].astype(jnp.float32)            # [chunk, 3]
+        return i + 1, acc + hist_tile_vals(rows, v, num_bins, impl)
 
     _, hist = lax.while_loop(
         cond, body, (jnp.int32(0), jnp.zeros((f, num_bins, 3), jnp.float32)))
     return hist
+
+
+def leaf_id_from_partition(part: RowPartition, num_data: int,
+                           num_leaves: int) -> jnp.ndarray:
+    """Reconstruct the per-row leaf assignment from the final ranges.
+
+    The leaf ranges tile [0, num_data) exactly (DataPartition invariant), so
+    position -> leaf is a searchsorted over the count-filtered sorted begins,
+    and row -> leaf is one scatter through ``order`` — O(N log L) dense work
+    once per tree instead of O(N x depth) scattered writes during growth.
+    """
+    # empty leaves sort past every real range
+    begins = jnp.where(part.leaf_count > 0, part.leaf_begin,
+                       jnp.int32(num_data + 1))
+    sort_begins, sort_leaf = lax.sort(
+        (begins, jnp.arange(num_leaves, dtype=jnp.int32)), num_keys=1)
+    pos = jnp.arange(num_data, dtype=jnp.int32)
+    block = jnp.searchsorted(sort_begins, pos, side="right") - 1
+    pos_leaf = sort_leaf[jnp.clip(block, 0, num_leaves - 1)]
+    rows = jnp.minimum(part.order[:num_data], num_data - 1)
+    return jnp.zeros((num_data,), jnp.int32).at[rows].set(
+        pos_leaf, mode="promise_in_bounds")

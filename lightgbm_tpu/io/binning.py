@@ -17,6 +17,7 @@ device-resident artifact everything else trains on.
 """
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -41,12 +42,11 @@ class BinType:
 
 def _get_double_upper_bound(x: float) -> float:
     """Common::GetDoubleUpperBound — nextafter so values == boundary bin left."""
-    return float(np.nextafter(x, np.inf))
+    return math.nextafter(x, math.inf)
 
 
 def _check_double_equal(a: float, b: float) -> bool:
-    upper = np.nextafter(a, np.inf)
-    return bool(b <= upper)
+    return b <= math.nextafter(a, math.inf)
 
 
 def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
@@ -55,13 +55,16 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     num_distinct = len(distinct_values)
     bin_upper_bound: List[float] = []
     check(max_bin > 0, "max_bin should be > 0")
+    # plain lists: the loops below are scalar-sequential (running counts and
+    # adaptive thresholds), and numpy scalar indexing would dominate them
+    dv = distinct_values.tolist()
+    cn = counts.tolist()
     if num_distinct <= max_bin:
         cur_cnt = 0
         for i in range(num_distinct - 1):
-            cur_cnt += int(counts[i])
+            cur_cnt += cn[i]
             if cur_cnt >= min_data_in_bin:
-                val = _get_double_upper_bound(
-                    (distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                val = _get_double_upper_bound((dv[i] + dv[i + 1]) / 2.0)
                 if not bin_upper_bound or not _check_double_equal(bin_upper_bound[-1], val):
                     bin_upper_bound.append(val)
                     cur_cnt = 0
@@ -73,25 +76,26 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     mean_bin_size = total_cnt / max_bin
     rest_bin_cnt = max_bin
     rest_sample_cnt = total_cnt
-    is_big = counts >= mean_bin_size
-    rest_bin_cnt -= int(is_big.sum())
-    rest_sample_cnt -= int(counts[is_big].sum())
+    is_big_np = counts >= mean_bin_size
+    rest_bin_cnt -= int(is_big_np.sum())
+    rest_sample_cnt -= int(counts[is_big_np].sum())
+    is_big = is_big_np.tolist()
     mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
     upper_bounds = [float("inf")] * max_bin
     lower_bounds = [float("inf")] * max_bin
 
     bin_cnt = 0
-    lower_bounds[0] = float(distinct_values[0])
+    lower_bounds[0] = dv[0]
     cur_cnt = 0
     for i in range(num_distinct - 1):
         if not is_big[i]:
-            rest_sample_cnt -= int(counts[i])
-        cur_cnt += int(counts[i])
+            rest_sample_cnt -= cn[i]
+        cur_cnt += cn[i]
         if (is_big[i] or cur_cnt >= mean_bin_size
                 or (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5))):
-            upper_bounds[bin_cnt] = float(distinct_values[i])
+            upper_bounds[bin_cnt] = dv[i]
             bin_cnt += 1
-            lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+            lower_bounds[bin_cnt] = dv[i + 1]
             if bin_cnt >= max_bin - 1:
                 break
             cur_cnt = 0
@@ -191,30 +195,38 @@ class BinMapper:
         zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
 
         values = np.sort(values, kind="stable")
-        distinct_values: List[float] = []
-        counts: List[int] = []
-        if len(values) == 0 or (values[0] > 0.0 and zero_cnt > 0):
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-        if len(values) > 0:
-            distinct_values.append(float(values[0]))
-            counts.append(1)
-        for i in range(1, len(values)):
-            if not _check_double_equal(values[i - 1], values[i]):
-                if values[i - 1] < 0.0 and values[i] > 0.0:
-                    distinct_values.append(0.0)
-                    counts.append(zero_cnt)
-                distinct_values.append(float(values[i]))
-                counts.append(1)
-            else:
-                distinct_values[-1] = float(values[i])
-                counts[-1] += 1
-        if len(values) > 0 and values[-1] < 0.0 and zero_cnt > 0:
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-
-        dv = np.asarray(distinct_values, dtype=np.float64)
-        ct = np.asarray(counts, dtype=np.int64)
+        if len(values):
+            # group ulp-adjacent values (CheckDoubleEqualOrdered): a new
+            # group starts where v[i] > nextafter(v[i-1], +inf); each
+            # group's representative is its LAST (largest) member — a
+            # vectorized replay of the reference's sequential merge walk
+            new_group = values[1:] > np.nextafter(values[:-1], np.inf)
+            last_of_group = np.nonzero(np.append(new_group, True))[0]
+            first_of_group = np.concatenate([[0], last_of_group[:-1] + 1])
+            dv = values[last_of_group].astype(np.float64)
+            gid = np.concatenate([[0], np.cumsum(new_group)])
+            ct = np.bincount(gid, minlength=len(dv)).astype(np.int64)
+            firsts = values[first_of_group]
+            # the implicit-zero entry lands exactly where the sequential
+            # walk placed it: before the first strictly-positive group when
+            # preceded by a strictly-negative one (inserted even with count
+            # 0), at the front/back only when zero_cnt > 0
+            pos_groups = np.nonzero(firsts > 0.0)[0]
+            j = int(pos_groups[0]) if len(pos_groups) else -1
+            if j == 0:
+                if zero_cnt > 0:
+                    dv = np.insert(dv, 0, 0.0)
+                    ct = np.insert(ct, 0, zero_cnt)
+            elif j > 0:
+                if dv[j - 1] < 0.0:
+                    dv = np.insert(dv, j, 0.0)
+                    ct = np.insert(ct, j, zero_cnt)
+            elif dv[-1] < 0.0 and zero_cnt > 0:
+                dv = np.append(dv, 0.0)
+                ct = np.append(ct, zero_cnt)
+        else:
+            dv = np.asarray([0.0], dtype=np.float64)
+            ct = np.asarray([zero_cnt], dtype=np.int64)
         self.min_val = float(dv[0]) if len(dv) else 0.0
         self.max_val = float(dv[-1]) if len(dv) else 0.0
 
@@ -238,12 +250,13 @@ class BinMapper:
             self.default_bin = self.value_to_bin(0.0)
             cnt_in_bin = np.zeros(self.num_bin, dtype=np.int64)
             if len(dv):
-                # sequential "value > bound -> next bin" walk over distinct values
-                i_bin = 0
-                for i in range(len(dv)):
-                    while i_bin < self.num_bin - 1 and dv[i] > self.bin_upper_bound[i_bin]:
-                        i_bin += 1
-                    cnt_in_bin[i_bin] += ct[i]
+                # first bin whose upper bound covers the value ("advance
+                # while dv > bound"), capped at the last bin — NaN bounds
+                # (missing bin) sort last so searchsorted stays valid
+                idx = np.minimum(
+                    np.searchsorted(self.bin_upper_bound, dv, side="left"),
+                    self.num_bin - 1)
+                np.add.at(cnt_in_bin, idx, ct)
             if self.missing_type == MissingType.NAN:
                 cnt_in_bin[self.num_bin - 1] = na_cnt
             check(self.num_bin <= max_bin, "num_bin exceeds max_bin")

@@ -31,7 +31,8 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     the booster's actual data/shapes. Keys: grad, hist_full, hist_leaf,
     find_split, partition."""
     from .core.histogram import build_histogram
-    from .core.partition import (hist_for_leaf, init_partition, split_leaf)
+    from .core.partition import (hist_for_leaf, init_partition, split_leaf,
+                                 stack_vals)
     from .core.split import find_best_split
 
     xb = booster.xb
@@ -68,8 +69,9 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
 
         part = init_partition(n, params.num_leaves, params.row_chunk)
         half = jnp.asarray(np.arange(n) % 2 == 0)
+        vals3 = stack_vals(g, h, mask)
         hist_leaf_fn = jax.jit(lambda p: hist_for_leaf(
-            p, jnp.int32(0), xb, g, h, mask, params.num_bins,
+            p, jnp.int32(0), xb, vals3, params.num_bins,
             params.row_chunk, impl=params.hist_impl))
         part2, _ = jax.jit(lambda p: split_leaf(
             p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),

@@ -84,7 +84,8 @@ def test_partition_leaf_counts_consistent():
         lambda p, l: split_leaf(p, l, jnp.int32(0), jnp.int32(1),
                                 lambda idx: jnp.take(decision, idx,
                                                      mode="clip"),
-                                jnp.asarray(True), chunk))(part, leaf_id)
+                                jnp.asarray(True), chunk,
+                                maintain_leaf_id=True))(part, leaf_id)
     lid = np.asarray(leaf_id)
     order = np.asarray(part.order)[:n]
     begin = np.asarray(part.leaf_begin)
@@ -96,3 +97,8 @@ def test_partition_leaf_counts_consistent():
     assert (lid[order[:count[0]]] == 0).all()
     assert (lid[order[count[0]:n]] == 1).all()
     assert count[0] == int(np.asarray(decision).sum())
+    # reconstruction from ranges matches the maintained assignment
+    from lightgbm_tpu.core.partition import leaf_id_from_partition
+    lid2 = np.asarray(jax.jit(
+        lambda p: leaf_id_from_partition(p, n, 8))(part))
+    np.testing.assert_array_equal(lid, lid2)
