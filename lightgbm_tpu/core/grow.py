@@ -7,13 +7,16 @@ TPU-native re-design of SerialTreeLearner::Train
 - The reference breaks out of the split loop when the best gain <= 0
   (serial_tree_learner.cpp:217-219); under jit the loop runs a fixed
   ``num_leaves - 1`` iterations with *masked no-op* splits instead.
-- DataPartition's index-shuffling (data_partition.hpp:20-37) becomes a per-row
-  ``leaf_id`` vector; partitioning a leaf is a masked elementwise update, and
-  the final ``leaf_id`` doubles as the score-update fast path
-  (score_updater.hpp:53-117).
-- The histogram-subtraction trick is kept: only the smaller child's histogram
-  is built (serial_tree_learner.cpp:383-397, 547-548); the sibling is
-  parent - child. Histograms for dead iterations are skipped via lax.cond.
+- Single-device growth keeps rows grouped by leaf (core/partition.py) and
+  fuses DataPartition::Split with ConstructHistograms: one pass over the
+  split leaf's rows partitions the range AND prices both children through
+  six value channels — no histogram pool, nothing to subtract. The final
+  ``leaf_id`` (reconstructed from the ranges) doubles as the score-update
+  fast path (score_updater.hpp:53-117).
+- Mesh paths use masked full-data passes with a per-row ``leaf_id`` vector
+  and keep the histogram-subtraction trick: only the smaller child's
+  histogram is built (serial_tree_learner.cpp:383-397, 547-548); the
+  sibling is parent - child. Dead iterations skip work via lax.cond.
 - Node numbering matches the reference exactly: splitting leaf ``l`` at step
   ``t`` creates internal node ``t``; the left child keeps leaf index ``l``,
   the right child becomes leaf ``t + 1`` (tree.cpp:49-67). Child pointers use
@@ -34,7 +37,8 @@ from jax import lax
 
 from .histogram import build_histogram
 from .partition import (RowPartition, hist_for_leaf, init_partition,
-                        leaf_id_from_partition, split_leaf, stack_vals)
+                        leaf_id_from_partition, partition_and_hist,
+                        stack_vals)
 from .split import (BestSplit, FeatureMeta, SplitParams, K_EPSILON,
                     K_MIN_SCORE, MISSING_NAN, MISSING_NONE, MISSING_ZERO,
                     calculate_leaf_output, find_best_split, leaf_split_gain,
@@ -424,19 +428,23 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                      gain_penalty=root_pen)  # root: depth 0
     best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
 
-    capped = 0 < params.pool_slots < l
+    capped = (0 < params.pool_slots < l) and not use_partition
     assert not (capped and axis_name is not None), \
         "histogram_pool_size cap is not supported on sharded learners " \
         "(rebuild-on-miss cannot psum under lax.cond)"
     assert not capped or params.pool_slots >= 2, \
         "a capped histogram pool needs at least 2 slots (both children " \
         "of a split are resident)"
-    num_slots = params.pool_slots if capped else l
+    # the partition path needs no pool at all: the fused pass prices both
+    # children directly, so there is no parent to subtract from, and forced
+    # splits rebuild any leaf's histogram from its rows
+    num_slots = 1 if use_partition else (params.pool_slots if capped else l)
     hist_pool = jnp.zeros((num_slots, ncols, b, 3), jnp.float32)
     if voting:
         # the pool holds LOCAL histograms in voting mode -> device-varying
         hist_pool = lax.pcast(hist_pool, (axis_name,), to="varying")
-    hist_pool = hist_pool.at[0].set(hist_root)
+    if not use_partition:
+        hist_pool = hist_pool.at[0].set(hist_root)
     pool_map0 = None
     if capped:
         pool_map0 = PoolMap(
@@ -449,6 +457,16 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         rebuilt from the leaf's rows (HistogramPool::Get miss path). Must
         run BEFORE the step's partition update — the rebuild walks the
         pre-split row partition / leaf_id."""
+        if use_partition:
+            # no pool in partition mode (only forced splits land here);
+            # dead iterations never pay for a rebuild
+            return lax.cond(
+                live,
+                lambda _: hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
+                                        params.row_chunk, valid=True,
+                                        impl=params.hist_impl),
+                lambda _: jnp.zeros((ncols, b, 3), jnp.float32),
+                operand=None)
         if not capped:
             return s.hist_pool[leaf_idx]
         sl = s.pool_map.slot_of_leaf[leaf_idx]
@@ -457,10 +475,6 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             return s.hist_pool[jnp.maximum(sl, 0)]
 
         def rebuild(_):
-            if use_partition:
-                return hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
-                                     params.row_chunk, valid=True,
-                                     impl=params.hist_impl)
             m = (s.leaf_id == leaf_idx).astype(jnp.float32) * sample_mask
             return hist_for_mask(m)
 
@@ -567,11 +581,14 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 return v
 
         if use_partition:
-            xb_flat = xb.reshape(-1)
-
-            def go_left_rows(idx):
-                colv = jnp.take(xb_flat, idx * ncols + stored_col,
-                                mode="clip")
+            def go_left_rows(rows):
+                # dynamic-column extract as a one-hot matvec — bin bytes
+                # are exact in f32, and a dense [chunk, C] @ [C] product
+                # avoids another indexed gather
+                onehot_col = (jnp.arange(ncols, dtype=jnp.int32)
+                              == stored_col).astype(jnp.float32)
+                colv = jnp.einsum("rc,c->r", rows.astype(jnp.float32),
+                                  onehot_col).astype(jnp.int32)
                 return _bin_go_left(
                     to_feat_bin(colv), cur.threshold, cur.default_left,
                     meta.missing_type[cur.feature],
@@ -579,9 +596,10 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     meta.default_bin[cur.feature],
                     cur.is_categorical, cur.cat_bitset)
 
-            part, leaf_id = split_leaf(s.part, s.leaf_id, leaf, right_leaf,
-                                       go_left_rows, valid, params.row_chunk,
-                                       maintain_leaf_id=maintain_lid)
+            part, leaf_id, hist_left_d, hist_right_d = partition_and_hist(
+                s.part, s.leaf_id, leaf, right_leaf, go_left_rows, valid,
+                params.row_chunk, xb, vals3, b, params.hist_impl,
+                maintain_leaf_id=maintain_lid)
         else:
             part = s.part
             col = jnp.take(xb, stored_col, axis=1)
@@ -654,11 +672,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         large_leaf = jnp.where(left_smaller, right_leaf, leaf)
 
         if use_partition:
-            # O(rows_in_leaf): gather only the smaller child's rows through
-            # the partition (dead iterations have count 0 -> zero trips)
-            hist_small = hist_for_leaf(part, small_leaf, xb, vals3, b,
-                                       params.row_chunk, valid=valid,
-                                       impl=params.hist_impl)
+            # both children came out of the fused partition pass
+            hist_small = jnp.where(left_smaller, hist_left_d, hist_right_d)
         elif axis_name is None:
             def live_hist(_):
                 m = (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
@@ -675,15 +690,23 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             hist_small = hist_for_mask(
                 (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
                 * valid.astype(jnp.float32))
-        hist_parent = leaf_hist(s, leaf, live=valid)
-        hist_large = hist_parent - hist_small
-        if not capped:
+        if use_partition:
+            # no subtraction, no pool: the sibling was priced in the same
+            # fused pass
+            hist_large = jnp.where(left_smaller, hist_right_d, hist_left_d)
+            pool_map = s.pool_map
+            hist_pool = s.hist_pool
+        elif not capped:
+            hist_parent = leaf_hist(s, leaf, live=valid)
+            hist_large = hist_parent - hist_small
             pool_map = s.pool_map
             hist_pool = s.hist_pool.at[small_leaf].set(
                 jnp.where(valid, hist_small, s.hist_pool[small_leaf]))
             hist_pool = hist_pool.at[large_leaf].set(
                 jnp.where(valid, hist_large, hist_pool[large_leaf]))
         else:
+            hist_parent = leaf_hist(s, leaf, live=valid)
+            hist_large = hist_parent - hist_small
             # LRU slot allocation (HistogramPool::Move/Get): the larger
             # child reuses the parent's slot when resident; the smaller
             # child takes the least-recently-used other slot. Evicted
@@ -761,12 +784,38 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 rp = cegb_gain_penalty(cegb_state, cur.right_count,
                                        (leaf_id == right_leaf)
                                        .astype(jnp.float32) * sample_mask)
-            bl = best_for(hist_left, cur.left_sum_grad, cur.left_sum_hess,
-                          cur.left_count, depth_ok, l_min, l_max,
-                          gain_penalty=lp)
-            br = best_for(hist_right, cur.right_sum_grad, cur.right_sum_hess,
-                          cur.right_count, depth_ok, r_min, r_max,
-                          gain_penalty=rp)
+            if voting:
+                bl = best_for(hist_left, cur.left_sum_grad,
+                              cur.left_sum_hess, cur.left_count, depth_ok,
+                              l_min, l_max, gain_penalty=lp)
+                br = best_for(hist_right, cur.right_sum_grad,
+                              cur.right_sum_hess, cur.right_count, depth_ok,
+                              r_min, r_max, gain_penalty=rp)
+                return bl, br
+            # both children's split searches are independent — one vmapped
+            # call instead of two sequential ones halves the small-op chain
+            # (the scalar-heavy bin scans dominate per-split latency once
+            # histogram building is fused into the partition pass)
+            hist2 = jnp.stack([hist_left, hist_right])
+            sg2 = jnp.stack([cur.left_sum_grad, cur.right_sum_grad])
+            sh2 = jnp.stack([cur.left_sum_hess, cur.right_sum_hess])
+            cc2 = jnp.stack([cur.left_count, cur.right_count])
+            mn2 = jnp.stack([l_min, r_min])
+            mx2 = jnp.stack([l_max, r_max])
+            if lp is None:
+                b2 = jax.vmap(
+                    lambda hh, sg, sh, cc, mn, mx: full_best(
+                        hh, sg, sh, cc, depth_ok, mn, mx))(
+                    hist2, sg2, sh2, cc2, mn2, mx2)
+            else:
+                pen2 = jnp.stack([lp, rp])
+                b2 = jax.vmap(
+                    lambda hh, sg, sh, cc, mn, mx, pen: full_best(
+                        hh, sg, sh, cc, depth_ok, mn, mx,
+                        gain_penalty=pen))(
+                    hist2, sg2, sh2, cc2, mn2, mx2, pen2)
+            bl = jax.tree.map(lambda a: a[0], b2)
+            br = jax.tree.map(lambda a: a[1], b2)
             return bl, br
 
         def dead_bests(_):

@@ -42,13 +42,16 @@ from jax.experimental import pallas as pl
 def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int):
     """One (feature_tile, row_tile) grid cell.
 
-    xb_ref: [Ft, C] uint8 binned values; vals_ref: [3, C] f32
-    (grad*mask, hess*mask, mask); out_ref: [3, Ft, Hi, 16] f32 accumulator.
+    xb_ref: [Ft, C] uint8 binned values; vals_ref: [K, C] f32 value
+    channels (K = 3: grad*mask, hess*mask, mask; K = 6: the same for both
+    children of a fused partition+histogram pass);
+    out_ref: [K, Ft, Hi, 16] f32 accumulator.
     """
     r = pl.program_id(1)
     xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
-    vals = vals_ref[...]                                     # [3, C]
+    vals = vals_ref[...]                                     # [K, C]
     ft, c = xb.shape
+    k = vals.shape[0]
 
     @pl.when(r == 0)
     def _init():
@@ -61,7 +64,7 @@ def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int):
         hi_eq = iota_hi == (x >> 4)                          # [Hi, C]
         lo_eq = iota_lo == (x & 15)                          # [16, C]
         a = jnp.where(hi_eq[None, :, :], vals[:, None, :],
-                      0.0).reshape(3 * hi_n, c)              # [3*Hi, C]
+                      0.0).reshape(k * hi_n, c)              # [K*Hi, C]
         # two-term bf16 split of the values operand; the one-hot operand is
         # exactly representable, so two default-precision MXU passes land
         # within ~3e-6 of a full-f32 contraction
@@ -72,11 +75,11 @@ def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int):
         eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
         part = jax.lax.dot_general(
             a_top, eqlo, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [3*Hi, 16]
+            preferred_element_type=jnp.float32)              # [K*Hi, 16]
         part += jax.lax.dot_general(
             a_rem, eqlo, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        out_ref[:, j, :, :] += part.reshape(3, hi_n, 16)
+        out_ref[:, j, :, :] += part.reshape(k, hi_n, 16)
 
 
 @functools.partial(jax.jit,
@@ -105,9 +108,10 @@ def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
                                 num_bins: int, row_tile: int = 2048,
                                 feature_tile: int = 8,
                                 interpret: bool = False) -> jnp.ndarray:
-    """Same kernel with pre-stacked values: vals [3, N]
-    (grad*mask, hess*mask, mask)."""
+    """Same kernel with pre-stacked value channels: vals [K, N] -> output
+    [F, B, K] (K = 3 for one histogram, 6 for a fused two-child pass)."""
     n, f = xb.shape
+    k = vals.shape[0]
     hi_n = max(1, (num_bins + 15) // 16)   # bins above num_bins stay zero
 
     f_pad = (-f) % feature_tile
@@ -123,12 +127,12 @@ def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
         grid=(fp // feature_tile, (n + n_pad) // row_tile),
         in_specs=[
             pl.BlockSpec((feature_tile, row_tile), lambda i, r: (i, r)),
-            pl.BlockSpec((3, row_tile), lambda i, r: (0, r)),
+            pl.BlockSpec((k, row_tile), lambda i, r: (0, r)),
         ],
-        out_specs=pl.BlockSpec((3, feature_tile, hi_n, 16),
+        out_specs=pl.BlockSpec((k, feature_tile, hi_n, 16),
                                lambda i, r: (0, i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((3, fp, hi_n, 16), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((k, fp, hi_n, 16), jnp.float32),
         interpret=interpret,
     )(xb_t, vals)
-    out = out.reshape(3, fp, hi_n * 16)
+    out = out.reshape(k, fp, hi_n * 16)
     return jnp.moveaxis(out, 0, -1)[:f, :num_bins]           # [F, B, 3]

@@ -28,11 +28,11 @@ def _timed(fn, *args, reps=3, **kw) -> float:
 
 def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     """Per-phase seconds for one boosting iteration's building blocks, using
-    the booster's actual data/shapes. Keys: grad, hist_full, hist_leaf,
-    find_split, partition."""
+    the booster's actual data/shapes. Keys: grad, hist_full,
+    partition_hist_fused, hist_leaf_half, find_split."""
     from .core.histogram import build_histogram
-    from .core.partition import (hist_for_leaf, init_partition, split_leaf,
-                                 stack_vals)
+    from .core.partition import (hist_for_leaf, init_partition,
+                                 partition_and_hist, stack_vals)
     from .core.split import find_best_split
 
     xb = booster.xb
@@ -68,21 +68,21 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
                                impl=params.hist_impl)
 
         part = init_partition(n, params.num_leaves, params.row_chunk)
-        half = jnp.asarray(np.arange(n) % 2 == 0)
+        half = jnp.asarray(np.arange(n, dtype=np.int64) % 2 == 0)
         vals3 = stack_vals(g, h, mask)
-        hist_leaf_fn = jax.jit(lambda p: hist_for_leaf(
-            p, jnp.int32(0), xb, vals3, params.num_bins,
-            params.row_chunk, impl=params.hist_impl))
-        part2, _ = jax.jit(lambda p: split_leaf(
+        # the real growth path: one fused pass that partitions the root and
+        # prices both children (core/partition.py partition_and_hist)
+        fused = jax.jit(lambda p: partition_and_hist(
             p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),
-            lambda idx: jnp.take(half, idx, mode="clip"),
-            jnp.asarray(True), params.row_chunk))(part)
-        out["partition"] = _timed(
-            jax.jit(lambda p: split_leaf(
-                p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),
-                lambda idx: jnp.take(half, idx, mode="clip"),
-                jnp.asarray(True), params.row_chunk)), part)
-        out["hist_leaf_half"] = _timed(hist_leaf_fn, part2)
+            lambda rows: half[:rows.shape[0]],
+            jnp.asarray(True), params.row_chunk, xb, vals3,
+            params.num_bins, params.hist_impl))
+        out["partition_hist_fused"] = _timed(lambda p: fused(p)[0], part)
+        part2 = fused(part)[0]
+        out["hist_leaf_half"] = _timed(
+            jax.jit(lambda p: hist_for_leaf(
+                p, jnp.int32(0), xb, vals3, params.num_bins,
+                params.row_chunk, impl=params.hist_impl)), part2)
 
         sum_g = jnp.sum(g)
         sum_h = jnp.sum(h)

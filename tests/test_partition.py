@@ -72,20 +72,31 @@ def test_partition_matches_masked(num_leaves, chunk):
 def test_partition_leaf_counts_consistent():
     """Partition bookkeeping: leaf ranges tile [0, N) and counts match the
     per-row leaf_id assignment."""
-    from lightgbm_tpu.core.partition import init_partition, split_leaf
+    from lightgbm_tpu.core.partition import (init_partition,
+                                             partition_and_hist, stack_vals)
 
     np.random.seed(4)
     n, chunk = 1000, 128
+    f, b = 3, 8
     part = init_partition(n, 8, chunk)
     leaf_id = jnp.zeros((n,), jnp.int32)
-    decision = jnp.asarray(np.random.rand(n) < 0.3)
+    decision_np = np.random.rand(n) < 0.3
+    # route the split decision through the gathered feature bytes, the way
+    # grow_tree does: column 0 holds the decision bit
+    xb = np.random.randint(0, b, (n, f)).astype(np.uint8)
+    xb[:, 0] = decision_np.astype(np.uint8)
+    vals = stack_vals(jnp.asarray(np.random.randn(n).astype(np.float32)),
+                      jnp.ones((n,), jnp.float32), jnp.ones((n,), jnp.float32))
 
-    part, leaf_id = jax.jit(
-        lambda p, l: split_leaf(p, l, jnp.int32(0), jnp.int32(1),
-                                lambda idx: jnp.take(decision, idx,
-                                                     mode="clip"),
-                                jnp.asarray(True), chunk,
-                                maintain_leaf_id=True))(part, leaf_id)
+    part, leaf_id, hl, hr = jax.jit(
+        lambda p, l: partition_and_hist(
+            p, l, jnp.int32(0), jnp.int32(1),
+            lambda rows: rows[:, 0] == 1,
+            jnp.asarray(True), chunk, jnp.asarray(xb), vals, b,
+            "scatter", maintain_leaf_id=True))(part, leaf_id)
+    # the fused histograms cover exactly each child's rows
+    assert int(np.asarray(hl)[0, 1, 2]) == int(decision_np.sum())
+    assert int(np.asarray(hr)[0, 0, 2]) == int((~decision_np).sum())
     lid = np.asarray(leaf_id)
     order = np.asarray(part.order)[:n]
     begin = np.asarray(part.leaf_begin)
@@ -96,7 +107,7 @@ def test_partition_leaf_counts_consistent():
     np.testing.assert_array_equal(np.sort(order), np.arange(n))
     assert (lid[order[:count[0]]] == 0).all()
     assert (lid[order[count[0]:n]] == 1).all()
-    assert count[0] == int(np.asarray(decision).sum())
+    assert count[0] == int(decision_np.sum())
     # reconstruction from ranges matches the maintained assignment
     from lightgbm_tpu.core.partition import leaf_id_from_partition
     lid2 = np.asarray(jax.jit(
