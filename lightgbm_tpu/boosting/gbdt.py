@@ -283,6 +283,18 @@ class GBDT:
             raise LightGBMError("forced splits / CEGB are not supported "
                                 "with the voting-parallel tree learner")
 
+        # explicit shard_map data-parallel learner: every device partitions
+        # its local row shard and only child histograms cross the mesh
+        # (data_parallel_tree_learner.cpp:146-161). Forced splits and CEGB
+        # keep the masked GSPMD path (their cond-guarded rebuilds / row
+        # accounting cannot sit on the sharded partition).
+        self._partition_on_mesh = (
+            self.mesh is not None
+            and cfg.tree_learner == "data"
+            and mesh_mod.DATA_AXIS in self.mesh.axis_names
+            and num_forced == 0
+            and self._cegb_state is None)
+
         self.grow_params = GrowParams(
             num_leaves=cfg.num_leaves,
             num_bins=self.num_bins,
@@ -314,7 +326,8 @@ class GBDT:
                           and self.mesh is not None else 0),
             with_categorical=bool(np.asarray(self.feature_meta.is_categorical)
                                   .any()),
-            use_partition=(self.mesh is None),
+            use_partition=(self.mesh is None or self._partition_on_mesh),
+            partition_on_mesh=self._partition_on_mesh,
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
@@ -570,17 +583,20 @@ class GBDT:
                 h = h * mult[:, None]
                 sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
 
-            if params.voting_top_k > 0:
-                # voting-parallel: explicit shard_map so the PV-Tree election
-                # collectives (all_gather of proposals, psum of elected
-                # candidates only) are manual, not GSPMD-inferred
+            if params.partition_on_mesh or params.voting_top_k > 0:
+                # explicit shard_map learners (mutually exclusive configs):
+                # - data-parallel partition: local fused partition+hist per
+                #   device, psum only on the [F, B, 6] child histograms;
+                # - voting-parallel: manual PV-Tree election collectives
+                #   (all_gather of proposals, psum of elected candidates).
+                # check_vma=False: the replicated tree output is
+                # device-identical by construction (psum'd histograms /
+                # identical election), but the varying-axes type system
+                # cannot prove it through the growth loop
                 from jax.sharding import PartitionSpec as P
                 from ..parallel.mesh import DATA_AXIS
                 tree_spec = jax.tree.map(lambda _: P(),
                                          empty_tree(params.num_leaves))
-                # check_vma=False: the election (all_gather -> identical vote
-                # -> identical top-k) is device-identical by construction, but
-                # the varying-axes type system cannot prove it
                 grow_sharded = jax.shard_map(
                     lambda xbj, gj, hj, mj, fm: grow_tree(
                         xbj, gj, hj, mj, meta, fm, params,

@@ -62,10 +62,16 @@ class GrowParams(NamedTuple):
     with_categorical: bool = False
     # row-partition mode (DataPartition analog, core/partition.py): keep rows
     # grouped by leaf and build each histogram only over the leaf's rows —
-    # O(N x depth) row visits per tree instead of O(N x num_leaves). Single
-    # device only; mesh paths keep masked full passes (a gather through a
-    # sharded order array would defeat GSPMD).
+    # O(N x depth) row visits per tree instead of O(N x num_leaves).
     use_partition: bool = False
+    # allow the partition path under an explicit shard_map data-parallel
+    # learner: every device partitions its LOCAL row shard (trip counts
+    # diverge freely — no collective sits inside the chunk loops) and only
+    # the fused [F, B, 6] child histograms are psum-combined, the
+    # ReduceScatter moment of data_parallel_tree_learner.cpp:146-161.
+    # GSPMD paths must keep this off (a gather through a sharded order
+    # array would shuffle rows across devices).
+    partition_on_mesh: bool = False
     # EFB (io/bundle.py): histograms are built over stored bundle columns
     # ([C, num_bins]) and expanded to per-feature views ([F, num_feat_bins])
     # before split search; split decisions decode column values through
@@ -281,7 +287,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sp = params.split
 
     voting = params.voting_top_k > 0 and axis_name is not None
-    use_partition = params.use_partition and axis_name is None
+    use_partition = params.use_partition and (
+        axis_name is None or (params.partition_on_mesh and not voting))
 
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
@@ -435,6 +442,10 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     assert not capped or params.pool_slots >= 2, \
         "a capped histogram pool needs at least 2 slots (both children " \
         "of a split are resident)"
+    assert not (use_partition and axis_name is not None
+                and params.num_forced > 0), \
+        "forced splits need a leaf-histogram rebuild under lax.cond, which " \
+        "cannot psum on the sharded partition path (use the masked learner)"
     # the partition path needs no pool at all: the fused pass prices both
     # children directly, so there is no parent to subtract from, and forced
     # splits rebuild any leaf's histogram from its rows
@@ -487,6 +498,11 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # it starts as a constant but becomes a function of the sharded rows
         leaf_id0 = lax.pcast(leaf_id0, (axis_name,), to="varying")
     part0 = init_partition(n, l, params.row_chunk) if use_partition else None
+    if part0 is not None and axis_name is not None:
+        # same pcast story as leaf_id0: starts constant, becomes a function
+        # of the device-local rows
+        part0 = jax.tree.map(
+            lambda a: lax.pcast(a, (axis_name,), to="varying"), part0)
     state = _GrowState(leaf_id=leaf_id0, hist_pool=hist_pool,
                        best=best, tree=tree,
                        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
@@ -600,6 +616,13 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 s.part, s.leaf_id, leaf, right_leaf, go_left_rows, valid,
                 params.row_chunk, xb, vals3, b, params.hist_impl,
                 maintain_leaf_id=maintain_lid)
+            if axis_name is not None:
+                # one collective per split: psum the fused 6-channel
+                # accumulator, not the two child views separately
+                both = psum(jnp.concatenate([hist_left_d, hist_right_d],
+                                            axis=2))
+                hist_left_d = both[:, :, :3]
+                hist_right_d = both[:, :, 3:]
         else:
             part = s.part
             col = jnp.take(xb, stored_col, axis=1)

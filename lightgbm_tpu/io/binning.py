@@ -371,9 +371,15 @@ class BinMapper:
             return out
         has_nan_bin = self.missing_type == MissingType.NAN
         n_numeric = self.num_bin - (1 if has_nan_bin else 0)
+        bounds = self.bin_upper_bound[:max(n_numeric - 1, 0)]
+        if len(values) >= 65536:
+            from ..native import bin_numeric_native
+            nb = bin_numeric_native(values, bounds,
+                                    self.num_bin - 1 if has_nan_bin else -1)
+            if nb is not None:
+                return nb
         nan_mask = np.isnan(values)
         safe = np.where(nan_mask, 0.0, values)
-        bounds = self.bin_upper_bound[:max(n_numeric - 1, 0)]
         bins = np.searchsorted(bounds, safe, side="left").astype(np.int32)
         # searchsorted 'left': first idx where bounds[idx] >= v, i.e. v <= bound
         if has_nan_bin:

@@ -38,10 +38,13 @@ class _ParseResult(ctypes.Structure):
 
 def _build() -> Optional[str]:
     so = os.path.join(_NATIVE_DIR, _LIB_NAME)
-    src = os.path.join(_NATIVE_DIR, "src", "text_parser.cpp")
-    if not os.path.exists(src):
+    srcs = [os.path.join(_NATIVE_DIR, "src", f)
+            for f in ("text_parser.cpp", "binning.cpp")]
+    srcs = [f for f in srcs if os.path.exists(f)]
+    if not srcs:
         return None
-    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+    if os.path.exists(so) and \
+            os.path.getmtime(so) >= max(os.path.getmtime(f) for f in srcs):
         return so
     try:
         r = subprocess.run(["make", "-C", _NATIVE_DIR],
@@ -73,8 +76,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
                 ctypes.POINTER(_ParseResult), ctypes.c_char_p, ctypes.c_int]
             lib.LGBMT_FreeParseResult.argtypes = [ctypes.POINTER(_ParseResult)]
+            lib.LGBMT_BinNumeric.restype = None
+            lib.LGBMT_BinNumeric.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
             _lib = lib
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale prebuilt .so from before a symbol was
+            # added — fall back to Python rather than crash dataset loading
             Log.warning("cannot load native library: %s", e)
             _lib = None
         return _lib
@@ -112,3 +122,27 @@ def parse_file_native(path: str, has_header: bool, label_idx: int
         delim = "\t" if "\t" in header else ("," if "," in header else " ")
         tokens = header.strip().split(delim)
     return X, y, tokens, fmt
+
+
+def bin_numeric_native(values: np.ndarray, bounds: np.ndarray,
+                       nan_bin: int) -> Optional[np.ndarray]:
+    """Assign bins for a numeric column with the OpenMP binner
+    (native/src/binning.cpp); None when the library is unavailable.
+
+    ``bounds`` are the numeric upper bounds excluding the +inf sentinel;
+    ``nan_bin`` >= 0 routes NaN there, < 0 treats NaN as 0.0. Matches
+    BinMapper.values_to_bins (searchsorted "left") exactly.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    bounds = np.ascontiguousarray(bounds, dtype=np.float64)
+    out = np.empty(len(values), dtype=np.int32)
+    lib.LGBMT_BinNumeric(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(values)),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int32(len(bounds)), ctypes.c_int32(nan_bin),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out

@@ -1,0 +1,36 @@
+// Native hot path of feature quantization (BinMapper::ValueToBin applied to
+// a whole column) — the OpenMP analog of the reference's bin assignment
+// (include/LightGBM/bin.h:457-493 binary search; src/io/dataset.cpp
+// PushOneRow). Python's per-column numpy searchsorted is single-threaded;
+// this parallelizes across rows and is wired through lightgbm_tpu.native
+// with a numpy fallback.
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// values [n] float64 -> out [n] int32 bin indices.
+// bounds [n_search] are the numeric upper bounds (excluding the +inf
+// sentinel): the assigned bin is the first index whose bound >= value
+// (searchsorted "left"), matching BinMapper.values_to_bins.
+// nan_bin >= 0 routes NaN to that bin (MissingType NaN); nan_bin < 0
+// treats NaN as 0.0 (MissingType None/Zero).
+void LGBMT_BinNumeric(const double* values, int64_t n, const double* bounds,
+                      int32_t n_search, int32_t nan_bin, int32_t* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    double v = values[i];
+    if (std::isnan(v)) {
+      if (nan_bin >= 0) {
+        out[i] = nan_bin;
+        continue;
+      }
+      v = 0.0;
+    }
+    out[i] = static_cast<int32_t>(
+        std::lower_bound(bounds, bounds + n_search, v) - bounds);
+  }
+}
+
+}  // extern "C"

@@ -74,6 +74,42 @@ def test_data_parallel_uneven_rows():
     assert int(t.leaf_count[:t.num_leaves_actual].sum()) == 2005
 
 
+def test_data_parallel_uses_sharded_partition():
+    """tree_learner=data rides the explicit shard_map partition path (each
+    device partitions its local rows; only child histograms psum) whenever
+    forced splits / CEGB are absent — and still matches serial training."""
+    X, y = make_binary(n=2000)
+    dp = _train({"objective": "binary", "metric": "auc",
+                 "tree_learner": "data", "verbosity": -1}, X, y)
+    assert dp._partition_on_mesh
+    assert dp.grow_params.partition_on_mesh
+    serial = _train({"objective": "binary", "metric": "auc",
+                     "verbosity": -1}, X, y)
+    np.testing.assert_allclose(serial.predict(X[:200], raw_score=True),
+                               dp.predict(X[:200], raw_score=True),
+                               rtol=1e-3, atol=1e-3)
+    # CEGB configs must drop back too — even when cegb_tradeoff is 0 a
+    # positive cegb_penalty_split creates live CEGB state (regression: the
+    # old gate multiplied the two and let state reach the partition path)
+    dp3 = _train({"objective": "binary", "tree_learner": "data",
+                  "cegb_tradeoff": 0.0, "cegb_penalty_split": 5.0,
+                  "verbosity": -1}, X, y, rounds=2)
+    assert not dp3._partition_on_mesh
+    # forced-split configs must drop back to the masked GSPMD learner
+    import json, tempfile, os
+    fs = {"feature": 0, "threshold": float(np.median(X[:, 0]))}
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump(fs, f)
+        path = f.name
+    try:
+        dp2 = _train({"objective": "binary", "tree_learner": "data",
+                      "forcedsplits_filename": path, "verbosity": -1},
+                     X, y, rounds=2)
+        assert not dp2._partition_on_mesh
+    finally:
+        os.unlink(path)
+
+
 def test_feature_parallel_matches_serial():
     X, y = make_binary(n=1500)
     serial = _train({"objective": "binary", "metric": "auc",
