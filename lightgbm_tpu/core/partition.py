@@ -100,16 +100,10 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
     beg = part.leaf_begin[leaf]
     cnt = jnp.where(valid, part.leaf_count[leaf], 0)
 
-    def cond(c):
-        i = c[0]
-        return i * chunk < cnt
-
-    def body(c):
-        i, nl, nr, order_new, lid, acc = c
-        start = beg + i * chunk
+    def load_tile(start, in_range):
+        """Shared tile load: gather rows + values, decide the split, weight
+        the six child channels, add the histogram tile."""
         idx = lax.dynamic_slice(part.order, (start,), (chunk,))
-        j = jnp.arange(chunk, dtype=jnp.int32)
-        in_range = (i * chunk + j) < cnt
         idx_safe = jnp.minimum(idx, n_rows - 1)
         rows = xb.at[idx_safe].get(mode="promise_in_bounds")   # [chunk, F]
         v = vals.at[idx_safe].get(mode="promise_in_bounds") \
@@ -120,7 +114,28 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
         v6 = jnp.concatenate([v * is_l[:, None].astype(jnp.float32),
                               v * is_r[:, None].astype(jnp.float32)],
                              axis=1)                           # [chunk, 6]
-        acc = acc + hist_tile_vals(rows, v6, num_bins, impl)
+        hist = hist_tile_vals(rows, v6, num_bins, impl)
+        return idx, idx_safe, go_left, is_l, is_r, hist
+
+    def maybe_lid(lid, idx_safe, is_r):
+        if not maintain_leaf_id:
+            return lid
+        # max-scatter: right_leaf exceeds every id assigned so far; left
+        # rows keep their id; padded/OOB duplicates contribute 0
+        val = jnp.where(is_r, right_leaf, 0).astype(lid.dtype)
+        return lid.at[idx_safe].max(val, mode="promise_in_bounds")
+
+    def cond(c):
+        i = c[0]
+        return i * chunk < cnt
+
+    def body(c):
+        i, nl, nr, order_new, lid, acc = c
+        j = jnp.arange(chunk, dtype=jnp.int32)
+        in_range = (i * chunk + j) < cnt
+        idx, idx_safe, go_left, is_l, is_r, hist = load_tile(
+            beg + i * chunk, in_range)
+        acc = acc + hist
         # in_range is a prefix mask, so within range the right-side running
         # count is (position + 1) - left count: one cumsum covers both
         cl = jnp.cumsum(is_l.astype(jnp.int32))
@@ -132,17 +147,45 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
         pos = jnp.where(go_left, lpos, rpos)
         pos = jnp.where(in_range, pos, trash)
         order_new = order_new.at[pos].set(idx, mode="promise_in_bounds")
-        if maintain_leaf_id:
-            # max-scatter: right_leaf exceeds every id assigned so far;
-            # left rows keep their id; padded/OOB duplicates contribute 0
-            val = jnp.where(is_r, right_leaf, 0).astype(lid.dtype)
-            lid = lid.at[idx_safe].max(val, mode="promise_in_bounds")
+        lid = maybe_lid(lid, idx_safe, is_r)
         return (i + 1, nl + kl, nr + kr, order_new, lid, acc)
 
-    init = (jnp.int32(0), jnp.int32(0), jnp.int32(0), part.order, leaf_id,
-            jnp.zeros((f, num_bins, 6), jnp.float32))
-    _, n_left, n_right, order_new, leaf_id, acc6 = lax.while_loop(
-        cond, body, init)
+    def multi_trip(_):
+        init = (jnp.int32(0), jnp.int32(0), jnp.int32(0), part.order,
+                leaf_id, jnp.zeros((f, num_bins, 6), jnp.float32))
+        _, nl, nr, order_new, lid, acc = lax.while_loop(cond, body, init)
+        return order_new, lid, nl, nr, acc
+
+    if not impl.startswith("pallas"):
+        # CPU impls: XLA's scatter is cheap and the sort below is not; the
+        # bare while_loop already handles cnt == 0 (zero trips) and single
+        # trips without extra traced branches
+        order_new, leaf_id, n_left, n_right, acc6 = multi_trip(None)
+    else:
+        def single_trip(_):
+            # cnt <= chunk: the whole leaf fits in one tile, and the stable
+            # partition becomes a SORT + one contiguous
+            # dynamic-update-slice — no scatter, no cumsum (both are
+            # latency-bound on TPU). The tail of the slice reads whatever
+            # follows the leaf's range (the next leaf's rows / the
+            # padding); keyed 2 it sorts stably to the back and is written
+            # back unchanged, so the rest of ``order`` is untouched.
+            in_range = jnp.arange(chunk, dtype=jnp.int32) < cnt
+            idx, idx_safe, _, is_l, is_r, acc = load_tile(beg, in_range)
+            key = jnp.where(is_l, 0, jnp.where(is_r, 1, 2)).astype(jnp.uint8)
+            _, sidx = lax.sort((key, idx), num_keys=1, is_stable=True)
+            order_new = lax.dynamic_update_slice(part.order, sidx, (beg,))
+            lid = maybe_lid(leaf_id, idx_safe, is_r)
+            return (order_new, lid, jnp.sum(is_l.astype(jnp.int32)),
+                    jnp.sum(is_r.astype(jnp.int32)), acc)
+
+        def dead(_):
+            return (part.order, leaf_id, jnp.int32(0), jnp.int32(0),
+                    jnp.zeros((f, num_bins, 6), jnp.float32))
+
+        which = jnp.where(cnt == 0, 0, jnp.where(cnt <= chunk, 1, 2))
+        order_new, leaf_id, n_left, n_right, acc6 = lax.switch(
+            which, [dead, single_trip, multi_trip], None)
 
     leaf_begin = part.leaf_begin.at[right_leaf].set(
         jnp.where(valid, beg + n_left, part.leaf_begin[right_leaf]))

@@ -113,3 +113,32 @@ def test_partition_leaf_counts_consistent():
     lid2 = np.asarray(jax.jit(
         lambda p: leaf_id_from_partition(p, n, 8))(part))
     np.testing.assert_array_equal(lid, lid2)
+
+
+def test_partition_sort_placement_matches_scatter_path():
+    """The pallas impl's single-trip sort+DUS placement must produce the
+    same partition and histograms as the chunked scatter path (interpret
+    mode exercises the sort branch on CPU)."""
+    np.random.seed(9)
+    n, f, b = 3000, 5, 64
+    xb = np.random.randint(0, b, (n, f)).astype(np.uint8)
+    grad = np.random.randn(n).astype(np.float32)
+    hess = (np.random.rand(n) + 0.5).astype(np.float32)
+    mask = np.ones(n, np.float32)
+    meta = _meta(f, b)
+    fm = jnp.ones((f,), bool)
+    out = {}
+    for impl in ("scatter", "pallas_interpret"):
+        p = GrowParams(num_leaves=15, num_bins=b, max_depth=-1,
+                       split=_split_params(), row_chunk=1024,
+                       hist_impl=impl, use_partition=True)
+        t_, li, _ = jax.jit(functools.partial(grow_tree, params=p))(
+            jnp.asarray(xb), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask), meta, fm)
+        out[impl] = (jax.tree.map(np.asarray, t_), np.asarray(li))
+    t0, l0 = out["scatter"]
+    t1, l1 = out["pallas_interpret"]
+    assert (l0 == l1).all()
+    np.testing.assert_array_equal(t0.split_feature, t1.split_feature)
+    np.testing.assert_allclose(t0.leaf_value, t1.leaf_value,
+                               rtol=1e-4, atol=1e-5)
