@@ -169,7 +169,23 @@ def main():
     _arm_watchdog()
     try:
         backend_info = _select_backend()
-        result = run_bench(backend_info)
+        try:
+            result = run_bench(backend_info)
+        except Exception as first:  # noqa: BLE001
+            # the Pallas kernel rides a remote-compile service that can
+            # fail transiently; one retry on the plain-XLA histogram path
+            # still produces a real number
+            if os.environ.get("BENCH_HIST_IMPL") or \
+                    backend_info.get("fallback"):
+                raise
+            os.environ["BENCH_HIST_IMPL"] = "matmul"
+            try:
+                result = run_bench(backend_info)
+            except Exception as second:
+                raise RuntimeError(
+                    "retry also failed: %r (first failure: %r)"
+                    % (second, first)) from first
+            result["pallas_error"] = repr(first)[:300]
     except Exception:  # noqa: BLE001 - the contract is one JSON line
         import traceback
         print(json.dumps({

@@ -328,6 +328,8 @@ class GBDT:
                                   .any()),
             use_partition=(self.mesh is None or self._partition_on_mesh),
             partition_on_mesh=self._partition_on_mesh,
+            vmapped_classes=(self.num_tree_per_iteration > 1
+                             and pool_slots == 0),
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the

@@ -94,6 +94,10 @@ class GrowParams(NamedTuple):
     cegb_split_penalty: float = 0.0
     with_cegb_coupled: bool = False
     with_cegb_lazy: bool = False
+    # grow_tree is class-batched under jax.vmap (multiclass, uncapped
+    # pool): lax.switch would then run every branch per split, so the
+    # sort-placement fast path must stay off
+    vmapped_classes: bool = False
     # histogram pool cap (HistogramPool, feature_histogram.hpp:646-820):
     # 0 = one slot per leaf (unlimited); otherwise S < num_leaves slots with
     # LRU eviction, rebuilding an evicted parent histogram from its rows
@@ -612,10 +616,16 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     meta.default_bin[cur.feature],
                     cur.is_categorical, cur.cat_bitset)
 
+            # sort placement: a TPU latency optimization (scatters are
+            # slow there, sorts are not); pallas_interpret opts in so CPU
+            # tests cover the branch
+            use_sort = (not params.vmapped_classes) and (
+                params.hist_impl == "pallas_interpret"
+                or jax.default_backend() != "cpu")
             part, leaf_id, hist_left_d, hist_right_d = partition_and_hist(
                 s.part, s.leaf_id, leaf, right_leaf, go_left_rows, valid,
                 params.row_chunk, xb, vals3, b, params.hist_impl,
-                maintain_leaf_id=maintain_lid)
+                maintain_leaf_id=maintain_lid, use_sort=use_sort)
             if axis_name is not None:
                 # one collective per split: psum the fused 6-channel
                 # accumulator, not the two child views separately

@@ -76,7 +76,8 @@ def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
 def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
                        go_left_from_rows, valid, chunk: int,
                        xb: jnp.ndarray, vals: jnp.ndarray, num_bins: int,
-                       impl: str, maintain_leaf_id: bool = False):
+                       impl: str, maintain_leaf_id: bool = False,
+                       use_sort: bool = False):
     """One pass over ``leaf``'s rows that BOTH partitions the range and
     builds both children's [F, B, 3] histograms.
 
@@ -89,7 +90,9 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
     what actually dominates on TPU (see module docstring).
 
     ``go_left_from_rows(rows[chunk, F]) -> bool[chunk]`` evaluates the split
-    decision directly on the gathered feature bytes.
+    decision directly on the gathered feature bytes. ``use_sort`` selects
+    the single-trip sort placement (TPU-profitable, and ILLEGAL under vmap
+    — the batching rule for lax.switch runs every branch).
 
     Returns (new_part, new_leaf_id, hist_left, hist_right).
     """
@@ -156,10 +159,11 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
         _, nl, nr, order_new, lid, acc = lax.while_loop(cond, body, init)
         return order_new, lid, nl, nr, acc
 
-    if not impl.startswith("pallas"):
-        # CPU impls: XLA's scatter is cheap and the sort below is not; the
-        # bare while_loop already handles cnt == 0 (zero trips) and single
-        # trips without extra traced branches
+    if not use_sort:
+        # two reasons to stay on the bare while_loop (which already handles
+        # cnt == 0 and single trips): on CPU XLA's scatter is cheap and the
+        # sort is not, and under vmap (multiclass class-batched growth)
+        # lax.switch would execute ALL branches per split
         order_new, leaf_id, n_left, n_right, acc6 = multi_trip(None)
     else:
         def single_trip(_):
