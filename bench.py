@@ -117,6 +117,22 @@ def run_bench(backend_info: dict) -> dict:
     iters_per_sec = iters / dt
     higgs_equiv = iters_per_sec * (n / HIGGS_ROWS)
     vs_baseline = higgs_equiv / BASELINE_ITERS_PER_SEC
+
+    # honesty guard: a timed run of silently-broken training (e.g. a kernel
+    # miscompiling on this toolchain) must not read as a perf result. The
+    # synthetic is learnable, so 2*iters rounds must clearly beat chance.
+    scores = np.asarray(b.scores[: n, 0])
+    order = np.argsort(scores)
+    ranks = np.empty(n); ranks[order] = np.arange(1, n + 1)
+    npos = float(y.sum())
+    auc = (ranks[y > 0].sum() - npos * (npos + 1) / 2) \
+        / max(npos * (n - npos), 1.0)
+    train_auc_ok = bool(auc > 0.75)
+    if not train_auc_ok:
+        # match the other failure paths: a broken run reports value 0 with
+        # an error, never a healthy-looking throughput number
+        higgs_equiv = 0.0
+        vs_baseline = 0.0
     phases = {}
     if os.environ.get("BENCH_PHASES", "1") != "0":
         try:
@@ -134,6 +150,11 @@ def run_bench(backend_info: dict) -> dict:
         "backend": backend_info.get("backend", "?"),
         "backend_fallback": bool(backend_info.get("fallback", False)),
         "probe_error": backend_info.get("probe_error", ""),
+        "train_auc": round(float(auc), 4),
+        "train_auc_ok": train_auc_ok,
+        **({} if train_auc_ok else
+           {"error": "training did not learn (train_auc %.3f <= 0.75); "
+                     "throughput zeroed" % auc}),
         "raw_iters_per_sec": round(iters_per_sec, 4),
         "rows_features_per_sec_per_chip": round(iters_per_sec * n * f, 1),
         "phase_seconds": {"binning": round(t_bin, 3),

@@ -38,7 +38,7 @@ from jax import lax
 from .histogram import build_histogram
 from .partition import (RowPartition, hist_for_leaf, init_partition,
                         leaf_id_from_partition, partition_and_hist,
-                        stack_vals)
+                        sort_placement_profitable, stack_vals)
 from .split import (BestSplit, FeatureMeta, SplitParams, K_EPSILON,
                     K_MIN_SCORE, MISSING_NAN, MISSING_NONE, MISSING_ZERO,
                     calculate_leaf_output, find_best_split, leaf_split_gain,
@@ -616,12 +616,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     meta.default_bin[cur.feature],
                     cur.is_categorical, cur.cat_bitset)
 
-            # sort placement: a TPU latency optimization (scatters are
-            # slow there, sorts are not); pallas_interpret opts in so CPU
-            # tests cover the branch
-            use_sort = (not params.vmapped_classes) and (
-                params.hist_impl == "pallas_interpret"
-                or jax.default_backend() != "cpu")
+            use_sort = sort_placement_profitable(params.hist_impl,
+                                                 params.vmapped_classes)
             part, leaf_id, hist_left_d, hist_right_d = partition_and_hist(
                 s.part, s.leaf_id, leaf, right_leaf, go_left_rows, valid,
                 params.row_chunk, xb, vals3, b, params.hist_impl,

@@ -73,6 +73,20 @@ def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
     return jnp.stack([grad * m, hess * m, m], axis=1)
 
 
+def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
+    """Single policy for partition_and_hist's use_sort flag: the sort
+    placement wins on device backends (scatters are latency-bound there),
+    pallas_interpret opts in so CPU tests cover the branch, and vmapped
+    class-batched growth must stay off it (lax.switch under vmap runs
+    every branch)."""
+    if vmapped:
+        return False
+    if hist_impl == "pallas_interpret":
+        return True
+    import jax
+    return jax.default_backend() != "cpu"
+
+
 def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
                        go_left_from_rows, valid, chunk: int,
                        xb: jnp.ndarray, vals: jnp.ndarray, num_bins: int,

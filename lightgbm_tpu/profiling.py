@@ -32,7 +32,8 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     partition_hist_fused, hist_leaf_half, find_split."""
     from .core.histogram import build_histogram
     from .core.partition import (hist_for_leaf, init_partition,
-                                 partition_and_hist, stack_vals)
+                                 partition_and_hist,
+                                 sort_placement_profitable, stack_vals)
     from .core.split import find_best_split
 
     xb = booster.xb
@@ -71,12 +72,15 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
         half = jnp.asarray(np.arange(n, dtype=np.int64) % 2 == 0)
         vals3 = stack_vals(g, h, mask)
         # the real growth path: one fused pass that partitions the root and
-        # prices both children (core/partition.py partition_and_hist)
+        # prices both children — same placement selection as grow_tree
+        # (sort path on device / pallas_interpret, scatter loop on CPU)
+        use_sort = sort_placement_profitable(params.hist_impl,
+                                             params.vmapped_classes)
         fused = jax.jit(lambda p: partition_and_hist(
             p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),
             lambda rows: half[:rows.shape[0]],
             jnp.asarray(True), params.row_chunk, xb, vals3,
-            params.num_bins, params.hist_impl))
+            params.num_bins, params.hist_impl, use_sort=use_sort))
         out["partition_hist_fused"] = _timed(lambda p: fused(p)[0], part)
         part2 = fused(part)[0]
         out["hist_leaf_half"] = _timed(
