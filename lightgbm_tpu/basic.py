@@ -123,6 +123,32 @@ class Dataset:
                 self._binned = BinnedDataset.load_binary(data)
                 return self
             from .io import parser as parser_mod
+            if cfg.two_round and self.used_indices is None:
+                # two-round streaming load: never materializes the float64
+                # matrix (dataset_loader.cpp >memory path). Subsets fall
+                # through to the one-shot path — they are in-memory anyway.
+                cat = (self.categorical_feature
+                       if self.categorical_feature != "auto" else None)
+                fn = (self.feature_name
+                      if self.feature_name != "auto" else None)
+                self._binned = BinnedDataset.from_file_two_round(
+                    data, cfg, reference=ref_binned,
+                    feature_names=fn, categorical_feature=cat)
+                if self.label is not None:
+                    self._binned.metadata.set_label(_to_1d(self.label))
+                w = (self.weight if self.weight is not None
+                     else parser_mod.load_weight_file(data))
+                if w is not None:
+                    self._binned.metadata.set_weight(_to_1d(w))
+                g = (self.group if self.group is not None
+                     else parser_mod.load_query_file(data))
+                if g is not None:
+                    self._binned.metadata.set_query(_to_1d(g))
+                isc = (self.init_score if self.init_score is not None
+                       else parser_mod.load_init_score_file(data))
+                if isc is not None:
+                    self._binned.metadata.set_init_score(np.asarray(isc))
+                return self
             X, y, names = parser_mod.parse_file(data, has_header=cfg.header,
                                                 label_column=cfg.label_column)
             if self.label is None:

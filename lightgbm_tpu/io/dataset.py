@@ -304,6 +304,84 @@ class BinnedDataset:
 
     # ------------------------------------------------------------ sharded
     @classmethod
+    def from_file_two_round(cls, path: str, config: Config,
+                            chunk_rows: int = 262144,
+                            reference: "BinnedDataset" = None,
+                            feature_names=None, categorical_feature=None
+                            ) -> "BinnedDataset":
+        """Two-round streaming load (two_round / use_two_round_loading —
+        dataset_loader.cpp:160-219's >memory path re-imagined host-side).
+
+        Round 1 streams the file once, reservoir-sampling up to
+        ``bin_construct_sample_cnt`` rows (bin mappers and the EFB/packing
+        layout come from the sample, exactly like the reference's sampled
+        bin finding) and collecting labels. Round 2 streams again, binning
+        each chunk against that layout into the preallocated uint8 matrix.
+        Peak float64 footprint is one chunk, not the whole file.
+        """
+        from .parser import parse_file_chunks
+        from ..log import check as _check
+
+        sample_cnt = int(config.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed)
+        sample_rows: list = []
+        labels: list = []
+        names = None
+        first_row = None
+        n_total = 0
+        for Xc, yc, chunk_names in parse_file_chunks(
+                path, has_header=config.header,
+                label_column=config.label_column, chunk_rows=chunk_rows):
+            labels.append(yc)
+            names = names or chunk_names
+            if first_row is None:
+                first_row = Xc[:1].copy()
+            if reference is None:
+                for i in range(Xc.shape[0]):
+                    # standard reservoir (Algorithm R): keeps original order
+                    # while filling, so sample == full data whenever
+                    # N <= sample_cnt. Rows are COPIED so the parent chunk
+                    # can be freed — holding views would keep every float64
+                    # chunk alive, defeating the streaming point.
+                    if n_total + i < sample_cnt:
+                        sample_rows.append(Xc[i].copy())
+                    else:
+                        j = rng.randint(0, n_total + i + 1)
+                        if j < sample_cnt:
+                            sample_rows[j] = Xc[i].copy()
+            n_total += Xc.shape[0]
+        _check(n_total > 0, "Data file %s is empty" % path)
+        label = np.concatenate(labels)
+
+        proto = reference
+        if proto is None:
+            proto = cls.from_matrix(
+                np.asarray(sample_rows), config,
+                feature_names=feature_names or names,
+                categorical_feature=categorical_feature)
+
+        xb = np.empty((n_total, proto.X_binned.shape[1]), np.uint8)
+        row = 0
+        for Xc, _yc, _names in parse_file_chunks(
+                path, has_header=config.header,
+                label_column=config.label_column, chunk_rows=chunk_rows):
+            bc = cls.from_matrix(Xc, config, reference=proto)
+            xb[row:row + Xc.shape[0]] = bc.X_binned
+            row += Xc.shape[0]
+
+        if reference is not None:
+            # a validation set binned against the training layout: clone the
+            # layout through the reference-alignment path (no sampling run)
+            ds = cls.from_matrix(first_row, config, reference=reference)
+        else:
+            ds = proto
+        ds.X_binned = xb
+        ds.num_data = n_total
+        ds.metadata = Metadata(n_total)
+        ds.metadata.set_label(label)
+        return ds
+
+    @classmethod
     def from_sharded(cls, local_data, config: Config, comm,
                      label: Optional[Sequence[float]] = None,
                      weight: Optional[Sequence[float]] = None,

@@ -167,3 +167,59 @@ def load_init_score_file(data_path: str) -> Optional[np.ndarray]:
     if not os.path.exists(wpath):
         return None
     return np.loadtxt(wpath, dtype=np.float64).reshape(-1)
+
+
+def parse_file_chunks(path: str, has_header: bool = False,
+                      label_column: str = "", chunk_rows: int = 262144):
+    """Stream a delimited data file as (X [c, F] float64, label [c]) chunks.
+
+    The two-round loading front end (dataset_loader.cpp:160-219's
+    >memory-file path): nothing larger than one chunk of float64 is ever
+    materialized. LibSVM needs a global feature count up front, so sparse
+    files take the one-shot parser instead.
+    """
+    check(os.path.exists(path), "Data file %s doesn't exist" % path)
+    with open(path, "r") as fh:
+        head = []
+        for line in fh:
+            if line.strip():
+                head.append(line)
+            if len(head) >= 11:
+                break
+    if not head:
+        raise LightGBMError("Data file %s is empty" % path)
+    kind, delim = _detect_format([l.rstrip("\n") for l in
+                                  (head[1:] if has_header else head)])
+    if kind == "libsvm":
+        raise LightGBMError(
+            "two_round loading supports delimited files only; "
+            "LibSVM input needs the one-shot parser")
+    header_names: Optional[List[str]] = None
+    with open(path, "r") as fh:
+        if has_header:
+            header_names = fh.readline().strip().split(delim)
+        label_idx = _resolve_label_idx(label_column, header_names)
+        names = None
+        if header_names is not None:
+            names = [h for i, h in enumerate(header_names)
+                     if i != label_idx]
+        buf: List[str] = []
+
+        def flush():
+            data = np.genfromtxt(io.StringIO("\n".join(buf)),
+                                 delimiter=delim, dtype=np.float64)
+            if data.ndim == 1:
+                data = data.reshape(len(buf), -1)
+            labels = data[:, label_idx].copy()
+            X = np.delete(data, label_idx, axis=1)
+            return X, labels
+
+        for line in fh:
+            if not line.strip():
+                continue
+            buf.append(line.rstrip("\n"))
+            if len(buf) >= chunk_rows:
+                yield flush() + (names,)
+                buf = []
+        if buf:
+            yield flush() + (names,)
