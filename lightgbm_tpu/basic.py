@@ -123,7 +123,8 @@ class Dataset:
                 self._binned = BinnedDataset.load_binary(data)
                 return self
             from .io import parser as parser_mod
-            if cfg.two_round and self.used_indices is None:
+            if cfg.two_round and self.used_indices is None \
+                    and not parser_mod.sniff_libsvm(data):
                 # two-round streaming load: never materializes the float64
                 # matrix (dataset_loader.cpp >memory path). Subsets fall
                 # through to the one-shot path — they are in-memory anyway.
@@ -779,6 +780,17 @@ class Booster:
                     if hist[i] > 0]
             return np.asarray(rows, np.float64).reshape(-1, 2)
         return hist, edges
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Value of a single leaf (reference basic.py:2329 /
+        LGBM_BoosterGetLeafValue)."""
+        models = self._impl.models
+        if not 0 <= tree_id < len(models):
+            raise LightGBMError("tree_id %d out of range" % tree_id)
+        t = models[tree_id]
+        if not 0 <= leaf_id < int(t.num_leaves_actual):
+            raise LightGBMError("leaf_id %d out of range" % leaf_id)
+        return float(t.leaf_value[leaf_id])
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """basic.py reset_parameter → learning-rate etc. mid-training."""

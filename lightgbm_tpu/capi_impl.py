@@ -301,3 +301,23 @@ def network_init(machines: str, local_listen_port: int, listen_time_out: int,
 def network_free() -> None:
     from .parallel import network
     network.free()
+
+
+def booster_reset_parameter(bst: Booster, params: str) -> None:
+    """LGBM_BoosterResetParameter: re-apply run-time tunable parameters
+    (c_api.h:458; routed through Booster.reset_parameter)."""
+    bst.reset_parameter(parse_params(params))
+
+
+def booster_num_feature(bst: Booster) -> int:
+    return int(bst.num_feature())
+
+
+def booster_get_leaf_value(bst: Booster, tree_idx: int, leaf_idx: int) -> float:
+    """LGBM_BoosterGetLeafValue (gbdt.h GetLeafValue analog)."""
+    return float(bst.get_leaf_output(tree_idx, leaf_idx))
+
+
+def dataset_feature_names(ds: Dataset) -> list:
+    b = ds.construct()._binned
+    return list(b.feature_names)

@@ -337,18 +337,24 @@ class BinnedDataset:
             if first_row is None:
                 first_row = Xc[:1].copy()
             if reference is None:
-                for i in range(Xc.shape[0]):
-                    # standard reservoir (Algorithm R): keeps original order
-                    # while filling, so sample == full data whenever
-                    # N <= sample_cnt. Rows are COPIED so the parent chunk
-                    # can be freed — holding views would keep every float64
-                    # chunk alive, defeating the streaming point.
-                    if n_total + i < sample_cnt:
-                        sample_rows.append(Xc[i].copy())
-                    else:
-                        j = rng.randint(0, n_total + i + 1)
-                        if j < sample_cnt:
-                            sample_rows[j] = Xc[i].copy()
+                # Algorithm R, vectorized per chunk: the fill phase keeps
+                # original order (sample == full data when N <= sample_cnt);
+                # afterwards each row i draws j ~ U[0, n_total+i] and
+                # replaces slot j when j < sample_cnt. Rows are COPIED so
+                # the parent float64 chunk can be freed — holding views
+                # would keep every chunk alive, defeating the streaming
+                # point.
+                c = Xc.shape[0]
+                fill = max(0, min(sample_cnt - n_total, c))
+                for i in range(fill):
+                    sample_rows.append(Xc[i].copy())
+                if fill < c:
+                    draws = (rng.random_sample(c - fill)
+                             * (n_total + np.arange(fill, c) + 1)
+                             ).astype(np.int64)
+                    hits = np.nonzero(draws < sample_cnt)[0]
+                    for i in hits:
+                        sample_rows[draws[i]] = Xc[fill + i].copy()
             n_total += Xc.shape[0]
         _check(n_total > 0, "Data file %s is empty" % path)
         label = np.concatenate(labels)

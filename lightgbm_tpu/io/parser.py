@@ -134,13 +134,8 @@ def parse_file(path: str, has_header: bool = False, label_column: str = "",
         return X, labels, header_names
 
     # delimited
-    data = np.genfromtxt(io.StringIO("\n".join(lines)), delimiter=delim,
-                         dtype=np.float64)
-    if data.ndim == 1:
-        data = data.reshape(len(lines), -1)
     label_idx = _resolve_label_idx(label_column, header_names)
-    labels = data[:, label_idx].copy()
-    X = np.delete(data, label_idx, axis=1)
+    X, labels = _parse_delimited_block(lines, delim, label_idx)
     if header_names is not None:
         header_names = [h for i, h in enumerate(header_names) if i != label_idx]
     return X, labels, header_names
@@ -167,6 +162,37 @@ def load_init_score_file(data_path: str) -> Optional[np.ndarray]:
     if not os.path.exists(wpath):
         return None
     return np.loadtxt(wpath, dtype=np.float64).reshape(-1)
+
+
+def sniff_libsvm(path: str) -> bool:
+    """True when the file looks like LibSVM (sparse k:v tokens) — the
+    two_round chunked loader needs a global feature count, so such files
+    take the one-shot parser instead."""
+    if not os.path.exists(path):
+        return False
+    head = []
+    with open(path, "r") as fh:
+        for line in fh:
+            if line.strip():
+                head.append(line.rstrip("\n"))
+            if len(head) >= 10:
+                break
+    if not head:
+        return False
+    kind, _ = _detect_format(head)
+    return kind == "libsvm"
+
+
+def _parse_delimited_block(lines: List[str], delim: str, label_idx: int):
+    """genfromtxt a block of delimited lines -> (X, labels). Shared by the
+    one-shot and chunked loaders so format fixes apply to both."""
+    data = np.genfromtxt(io.StringIO("\n".join(lines)), delimiter=delim,
+                         dtype=np.float64)
+    if data.ndim == 1:
+        data = data.reshape(len(lines), -1)
+    labels = data[:, label_idx].copy()
+    X = np.delete(data, label_idx, axis=1)
+    return X, labels
 
 
 def parse_file_chunks(path: str, has_header: bool = False,
@@ -206,13 +232,7 @@ def parse_file_chunks(path: str, has_header: bool = False,
         buf: List[str] = []
 
         def flush():
-            data = np.genfromtxt(io.StringIO("\n".join(buf)),
-                                 delimiter=delim, dtype=np.float64)
-            if data.ndim == 1:
-                data = data.reshape(len(buf), -1)
-            labels = data[:, label_idx].copy()
-            X = np.delete(data, label_idx, axis=1)
-            return X, labels
+            return _parse_delimited_block(buf, delim, label_idx)
 
         for line in fh:
             if not line.strip():

@@ -77,6 +77,21 @@ int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
 int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data);
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+
+/* Re-apply run-time tunable parameters (learning_rate etc.). */
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
+
+/* Number of features the model was trained on. */
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+
+/* Output value of one leaf (post-shrinkage, like the reference). */
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+
+/* Feature names of a constructed dataset; caller pre-allocates
+ * len >= num_feature slots of 128 bytes each. */
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
 int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
                                     const float* hess, int* is_finished);

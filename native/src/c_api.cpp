@@ -397,6 +397,57 @@ int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
   API_END();
 }
 
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_reset_parameter",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  API_BEGIN();
+  if (int_attr_call("booster_num_feature", handle, out_len)) return -1;
+  API_END();
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_leaf_value",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle), tree_idx,
+                    leaf_idx));
+  if (r == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_feature_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *num_feature_names = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    // 128-byte pre-allocated slots (the reference ABI contract); names are
+    // file-controlled, so truncate rather than overflow
+    std::strncpy(feature_names[i], s ? s : "", 127);
+    feature_names[i][127] = '\0';
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
   API_BEGIN();
   if (int_attr_call("booster_update", handle, is_finished)) return -1;
@@ -476,8 +527,10 @@ int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
   *out_len = static_cast<int>(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
     const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
-    std::strcpy(out_strs[i], s ? s : "");  // NOLINT: ABI contract —
-    // caller pre-allocates 128-byte slots, like the reference
+    // 128-byte pre-allocated slots (the reference ABI contract); metric
+    // names are bounded but truncate on principle rather than overflow
+    std::strncpy(out_strs[i], s ? s : "", 127);
+    out_strs[i][127] = '\0';
   }
   Py_DECREF(r);
   API_END();

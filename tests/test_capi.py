@@ -161,3 +161,50 @@ def test_merge_and_csr_predict(lib_path):
         assert lib.LGBM_BoosterFree(h) == 0
     for d in (ds1, ds2):
         assert lib.LGBM_DatasetFree(d) == 0
+
+
+def test_capi_extended_introspection(lib_path):
+    """ResetParameter / GetNumFeature / GetLeafValue / GetFeatureNames."""
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 500, 4, 1, b"verbosity=-1",
+        None, ctypes.byref(ds)) == 0, lib.LGBM_GetLastError()
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 500, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    nf = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetNumFeature(bst, ctypes.byref(nf)) == 0
+    assert nf.value == 4
+
+    assert lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.05") == 0, \
+        lib.LGBM_GetLastError()
+
+    lv = ctypes.c_double(0.0)
+    assert lib.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(lv)) == 0
+    assert np.isfinite(lv.value) and lv.value != 0.0
+    # out-of-range must fail loudly, not crash
+    assert lib.LGBM_BoosterGetLeafValue(bst, 99, 0, ctypes.byref(lv)) != 0
+
+    bufs = [ctypes.create_string_buffer(128) for _ in range(4)]
+    arr = (ctypes.c_char_p * 4)(*[ctypes.addressof(b) for b in bufs])
+    cnt = ctypes.c_int(0)
+    assert lib.LGBM_DatasetGetFeatureNames(
+        ds, ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.byref(cnt)) == 0, lib.LGBM_GetLastError()
+    assert cnt.value == 4
+    assert bufs[0].value.decode().startswith("Column_")
+    assert lib.LGBM_BoosterFree(bst) == 0
+    assert lib.LGBM_DatasetFree(ds) == 0

@@ -69,3 +69,19 @@ def test_two_round_valid_set_alignment(tmp_path):
               valid_sets=[lgb.Dataset(pv, reference=dtr)],
               valid_names=["v"], evals_result=ev, verbose_eval=False)
     assert ev["v"]["auc"][-1] > 0.9
+
+
+def test_two_round_libsvm_falls_back(tmp_path):
+    """LibSVM input cannot stream (needs a global feature count); two_round
+    silently takes the one-shot parser instead of failing."""
+    r = np.random.RandomState(3)
+    n = 400
+    lines = []
+    for i in range(n):
+        feats = " ".join("%d:%.4f" % (j, r.randn()) for j in range(4))
+        lines.append("%d %s" % (int(r.rand() > 0.5), feats))
+    path = os.path.join(tmp_path, "t.libsvm")
+    open(path, "w").write("\n".join(lines))
+    bst = lgb.train({"objective": "binary", "two_round": True,
+                     "verbosity": -1}, lgb.Dataset(path), num_boost_round=3)
+    assert np.isfinite(bst.predict(np.zeros((2, 4)))).all()
