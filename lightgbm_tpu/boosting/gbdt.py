@@ -162,6 +162,22 @@ def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta
         pack_partner=jnp.asarray(pack_partner))
 
 
+def _resolve_hist_impl(cfg: Config) -> str:
+    """Histogram-kernel dispatch (the GPUTreeLearner device-path analog,
+    tree_learner.cpp:9-31): CPU -> XLA scatter-add; device -> the Pallas
+    VMEM-accumulator kernel, with one-hot matmul as the explicit fallback.
+    gpu_use_dp (config.h:784) upgrades ANY pallas spelling — auto or
+    explicit — to its full-f32 Precision.HIGHEST variant."""
+    impl = cfg.tpu_hist_impl
+    if impl == "auto":
+        impl = ("scatter" if jax.default_backend() == "cpu" else "pallas")
+    if cfg.gpu_use_dp and impl.startswith("pallas") \
+            and "highest" not in impl:
+        impl = ("pallas_highest_interpret" if impl.endswith("interpret")
+                else "pallas_highest")
+    return impl
+
+
 class GBDT:
     """Boosting driver (include/LightGBM/boosting.h:22-294, gbdt.{h,cpp})."""
 
@@ -319,9 +335,7 @@ class GBDT:
             # CPU: XLA scatter-add wins; TPU: the Pallas VMEM-accumulator
             # kernel is the default device path (the GPUTreeLearner analog,
             # gpu_tree_learner.cpp:951-1045) — one-hot matmul is the fallback
-            hist_impl=(cfg.tpu_hist_impl if cfg.tpu_hist_impl != "auto" else
-                       ("scatter" if jax.default_backend() == "cpu"
-                        else "pallas")),
+            hist_impl=_resolve_hist_impl(cfg),
             voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
                           and self.mesh is not None else 0),
             with_categorical=bool(np.asarray(self.feature_meta.is_categorical)
@@ -621,20 +635,23 @@ class GBDT:
             # rebuild-on-miss lax.cond into a both-branches select, paying
             # a full rebuild every step — so k == 1 calls directly and a
             # capped multiclass run maps classes sequentially (which also
-            # keeps one pool's worth of live memory, the point of the cap)
+            # keeps one pool's worth of live memory, the point of the cap).
+            # params.vmapped_classes is the ONE predicate: grow_tree keys
+            # its sort-placement/pool decisions off the same flag this
+            # dispatch uses, so the two can never disagree.
             if k == 1:
                 t1, li1, cb1 = grow_one(g[:, 0], h[:, 0], cegb_state)
                 trees = jax.tree.map(lambda a: a[None], t1)
                 leaf_ids = li1[None]
                 cegb_out = (jax.tree.map(lambda a: a[None], cb1)
                             if cb1 is not None else None)
-            elif params.pool_slots > 0:
+            elif params.vmapped_classes:
+                trees, leaf_ids, cegb_out = jax.vmap(
+                    grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
+            else:
                 trees, leaf_ids, cegb_out = lax.map(
                     lambda gh: grow_one(gh[0], gh[1], cegb_state),
                     (g.T, h.T))
-            else:
-                trees, leaf_ids, cegb_out = jax.vmap(
-                    grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
             if cegb_state is not None:
                 # classes train from the iteration-start state; acquisitions
                 # merge across class trees for the next iteration (the

@@ -155,12 +155,13 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # ---- device (config.h:770-790); gpu_* accepted for compat, unused on TPU ----
     ("gpu_platform_id", int, -1, []),
     ("gpu_device_id", int, -1, []),
-    ("gpu_use_dp", bool, False, []),
+    ("gpu_use_dp", bool, False, []),          # true -> pallas_highest kernel
     # ---- TPU-specific extensions (no reference counterpart) ----
     ("tpu_hist_dtype", str, "float32", []),   # histogram accumulation dtype
     # histogram kernel: auto (pallas on TPU, scatter on CPU) | pallas |
-    # matmul | scatter | pallas_interpret — the GPUTreeLearner device-path
-    # dispatch analog (tree_learner.cpp:9-31 device_type axis)
+    # pallas_highest (full-f32 MXU contraction, ~2x cost, tightest parity —
+    # also selected by gpu_use_dp=true) | matmul | scatter | pallas_interpret
+    # — the GPUTreeLearner device-path dispatch analog (tree_learner.cpp:9-31)
     ("tpu_hist_impl", str, "auto", []),
     ("tpu_donate_buffers", bool, True, []),   # donate score/state buffers under jit
     ("mesh_shape", list, [], []),             # e.g. [8] / [4,2]; empty = all devices on one axis

@@ -61,10 +61,11 @@ def hist_tile_vals(xb_rows: jnp.ndarray, vals: jnp.ndarray, num_bins: int,
     (grad*mask, hess*mask, mask) -> [F, B, 3]. Used by the row-partition
     path (core/partition.py), which gathers the stacked values in a single
     indexed read per tile."""
-    if impl in ("pallas", "pallas_interpret"):
+    if impl.startswith("pallas"):
         from .histogram_pallas import build_histogram_pallas_vals
         return build_histogram_pallas_vals(
-            xb_rows, vals.T, num_bins, interpret=(impl == "pallas_interpret"))
+            xb_rows, vals.T, num_bins, interpret=impl.endswith("interpret"),
+            highest="highest" in impl)
     if impl == "scatter":
         return _hist_scatter(xb_rows, vals, num_bins)
     return _hist_chunk_matmul(xb_rows, vals, num_bins)
@@ -87,10 +88,12 @@ def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     Returns: [F, B, 3] f32.
     """
     n, f = xb.shape
-    if impl == "pallas" or impl == "pallas_interpret":
+    if impl.startswith("pallas"):
+        # pallas | pallas_highest | pallas_interpret | pallas_highest_interpret
         from .histogram_pallas import build_histogram_pallas
         return build_histogram_pallas(xb, grad, hess, mask, num_bins,
-                                      interpret=(impl == "pallas_interpret"))
+                                      interpret=impl.endswith("interpret"),
+                                      highest="highest" in impl)
     vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # [N, 3]
     if impl == "scatter" or n <= row_chunk:
         if impl == "scatter":

@@ -39,13 +39,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int):
+def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int, highest: bool):
     """One (feature_tile, row_tile) grid cell.
 
     xb_ref: [Ft, C] uint8 binned values; vals_ref: [K, C] f32 value
     channels (K = 3: grad*mask, hess*mask, mask; K = 6: the same for both
     children of a fused partition+histogram pass);
     out_ref: [K, Ft, Hi, 16] f32 accumulator.
+
+    ``highest``: contract in full f32 (Precision.HIGHEST) instead of the
+    default two-term bf16 split — ~2x the MXU cost, for users who need the
+    tightest reference parity (the gpu_use_dp analog, config.h:784).
     """
     r = pl.program_id(1)
     xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
@@ -65,31 +69,40 @@ def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int):
         lo_eq = iota_lo == (x & 15)                          # [16, C]
         a = jnp.where(hi_eq[None, :, :], vals[:, None, :],
                       0.0).reshape(k * hi_n, c)              # [K*Hi, C]
-        # two-term bf16 split of the values operand; the one-hot operand is
-        # exactly representable, so two default-precision MXU passes land
-        # within ~3e-6 of a full-f32 contraction
-        a_top = a.astype(jnp.bfloat16)
-        a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
-        # NB: build the one-hot in f32 and downcast — a direct bf16 select
-        # on the i1 mask trips a Mosaic relayout bug on this toolchain
-        eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
-        part = jax.lax.dot_general(
-            a_top, eqlo, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [K*Hi, 16]
-        part += jax.lax.dot_general(
-            a_rem, eqlo, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if highest:
+            eqlo = jnp.where(lo_eq, 1.0, 0.0)
+            part = jax.lax.dot_general(
+                a, eqlo, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)          # [K*Hi, 16]
+        else:
+            # two-term bf16 split of the values operand; the one-hot operand
+            # is exactly representable, so two default-precision MXU passes
+            # land within ~3e-6 of a full-f32 contraction
+            a_top = a.astype(jnp.bfloat16)
+            a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+            # NB: build the one-hot in f32 and downcast — a direct bf16
+            # select on the i1 mask trips a Mosaic relayout bug on this
+            # toolchain
+            eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                a_top, eqlo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [K*Hi, 16]
+            part += jax.lax.dot_general(
+                a_rem, eqlo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
         out_ref[:, j, :, :] += part.reshape(k, hi_n, 16)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_tile", "feature_tile",
-                                    "interpret"))
+                                    "interpret", "highest"))
 def build_histogram_pallas(xb: jnp.ndarray, grad: jnp.ndarray,
                            hess: jnp.ndarray, mask: jnp.ndarray,
                            num_bins: int, row_tile: int = 2048,
                            feature_tile: int = 8,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False,
+                           highest: bool = False) -> jnp.ndarray:
     """[N, F] uint8 bins + per-row values -> [F, B, 3] f32 histograms.
 
     Same contract as histogram.build_histogram. The feature-major transpose
@@ -98,16 +111,17 @@ def build_histogram_pallas(xb: jnp.ndarray, grad: jnp.ndarray,
     """
     vals = jnp.stack([grad * mask, hess * mask, mask], axis=0)   # [3, N]
     return build_histogram_pallas_vals(xb, vals, num_bins, row_tile,
-                                       feature_tile, interpret)
+                                       feature_tile, interpret, highest)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_tile", "feature_tile",
-                                    "interpret"))
+                                    "interpret", "highest"))
 def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
                                 num_bins: int, row_tile: int = 2048,
                                 feature_tile: int = 8,
-                                interpret: bool = False) -> jnp.ndarray:
+                                interpret: bool = False,
+                                highest: bool = False) -> jnp.ndarray:
     """Same kernel with pre-stacked value channels: vals [K, N] -> output
     [F, B, K] (K = 3 for one histogram, 6 for a fused two-child pass)."""
     n, f = xb.shape
@@ -121,7 +135,7 @@ def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
     vals = jnp.pad(vals, ((0, 0), (0, n_pad)))   # padded rows carry mask 0
     fp = f + f_pad
 
-    kernel = functools.partial(_hist_kernel, hi_n=hi_n)
+    kernel = functools.partial(_hist_kernel, hi_n=hi_n, highest=highest)
     out = pl.pallas_call(
         kernel,
         grid=(fp // feature_tile, (n + n_pad) // row_tile),

@@ -75,16 +75,32 @@ def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
 
 def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
     """Single policy for partition_and_hist's use_sort flag: the sort
-    placement wins on device backends (scatters are latency-bound there),
+    placement wins where scatters are latency-bound — measured on TPU only,
+    so the gate is TPU-shaped backends (including the axon PJRT plugin),
+    NOT every non-CPU backend: an untested GPU backend keeps the plain
+    scatter loop. ``LIGHTGBM_TPU_SORT_PLACEMENT=0/1`` overrides.
     pallas_interpret opts in so CPU tests cover the branch, and vmapped
-    class-batched growth must stay off it (lax.switch under vmap runs
-    every branch)."""
+    class-batched growth stays off it (lax.switch under vmap runs every
+    branch per split — legal, but a per-split performance cliff)."""
     if vmapped:
         return False
-    if hist_impl == "pallas_interpret":
+    import os
+    ov = os.environ.get("LIGHTGBM_TPU_SORT_PLACEMENT", "").strip().lower()
+    if ov in ("1", "true", "yes", "on"):
+        return True
+    if ov in ("0", "false", "no", "off"):
+        return False
+    if ov:
+        from ..log import Log
+        Log.warning("ignoring unrecognized LIGHTGBM_TPU_SORT_PLACEMENT=%r "
+                    "(use 0 or 1)" % ov)
+    if hist_impl.startswith("pallas") and hist_impl.endswith("interpret"):
         return True
     import jax
-    return jax.default_backend() != "cpu"
+    backend = jax.default_backend().lower()
+    # allow-list, not deny-list: an unknown plugin backend keeps the
+    # scatter loop too
+    return "tpu" in backend or "axon" in backend
 
 
 def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
@@ -105,8 +121,9 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
 
     ``go_left_from_rows(rows[chunk, F]) -> bool[chunk]`` evaluates the split
     decision directly on the gathered feature bytes. ``use_sort`` selects
-    the single-trip sort placement (TPU-profitable, and ILLEGAL under vmap
-    — the batching rule for lax.switch runs every branch).
+    the single-trip sort placement (TPU-profitable; keep it off under vmap
+    — the batching rule for lax.switch lowers to a select that runs every
+    branch per split, semantically fine but a performance cliff).
 
     Returns (new_part, new_leaf_id, hist_left, hist_right).
     """

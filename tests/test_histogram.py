@@ -127,3 +127,69 @@ def test_pallas_kernel_six_channel_matches_scatter():
                                     b, "pallas_interpret"))
     assert pal.shape == (f, b, 6)
     np.testing.assert_allclose(pal, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_pallas_highest_precision_matches_scatter_tighter():
+    """The full-f32 Precision.HIGHEST kernel variant (gpu_use_dp analog,
+    tpu_hist_impl=pallas_highest) must match the scatter reference at least
+    as tightly as the default two-term bf16 kernel — its whole point is
+    users who pay 2x MXU cost for the tightest parity."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.histogram import build_histogram, hist_tile_vals
+    r = np.random.RandomState(11)
+    n, f, b = 1200, 7, 256
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = np.abs(r.randn(n)).astype(np.float32)
+    m = (r.rand(n) > 0.3).astype(np.float32)
+    ref = np.asarray(build_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        num_bins=b, impl="scatter"))
+    hi = np.asarray(build_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        num_bins=b, impl="pallas_highest_interpret"))
+    lo = np.asarray(build_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        num_bins=b, impl="pallas_interpret"))
+    np.testing.assert_allclose(hi, ref, rtol=1e-5, atol=1e-5)
+    assert np.abs(hi - ref).max() <= np.abs(lo - ref).max() + 1e-7
+    # 6-channel (fused two-child) layout too
+    vals6 = r.randn(n, 6).astype(np.float32)
+    ref6 = np.asarray(hist_tile_vals(jnp.asarray(xb), jnp.asarray(vals6),
+                                     b, "scatter"))
+    hi6 = np.asarray(hist_tile_vals(jnp.asarray(xb), jnp.asarray(vals6),
+                                    b, "pallas_highest_interpret"))
+    np.testing.assert_allclose(hi6, ref6, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("fake_backend,plain_expected", [
+    ("cpu", False), ("gpu", False), ("METAL", False), ("neuron", False),
+    ("tpu", True), ("axon", True)])
+def test_sort_placement_gate_is_allow_list(monkeypatch, fake_backend,
+                                           plain_expected):
+    """Sort placement was measured profitable on TPU only: unknown or GPU
+    backends must keep the scatter loop; env var overrides both ways."""
+    import jax
+    from lightgbm_tpu.core import partition
+    monkeypatch.delenv("LIGHTGBM_TPU_SORT_PLACEMENT", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: fake_backend)
+    sort_placement_profitable = partition.sort_placement_profitable
+    assert not sort_placement_profitable("pallas", vmapped=True)
+    assert sort_placement_profitable("pallas", vmapped=False) \
+        == plain_expected
+    assert sort_placement_profitable("matmul", vmapped=False) \
+        == plain_expected
+    # interpret spellings opt in so CPU tests cover the sort branch
+    assert sort_placement_profitable("pallas_interpret", vmapped=False)
+    assert sort_placement_profitable("pallas_highest_interpret",
+                                     vmapped=False)
+    monkeypatch.setenv("LIGHTGBM_TPU_SORT_PLACEMENT", "1")
+    assert sort_placement_profitable("pallas", vmapped=False)
+    assert not sort_placement_profitable("pallas", vmapped=True)
+    monkeypatch.setenv("LIGHTGBM_TPU_SORT_PLACEMENT", "off")
+    assert not sort_placement_profitable("pallas_interpret", vmapped=False)
+    monkeypatch.setenv("LIGHTGBM_TPU_SORT_PLACEMENT", "bogus")
+    # unrecognized spelling: warn, fall back to the backend gate
+    assert sort_placement_profitable("pallas", vmapped=False) \
+        == plain_expected
+    assert sort_placement_profitable("pallas_interpret", vmapped=False)
