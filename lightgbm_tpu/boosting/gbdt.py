@@ -299,6 +299,21 @@ class GBDT:
             raise LightGBMError("forced splits / CEGB are not supported "
                                 "with the voting-parallel tree learner")
 
+        # batched-frontier growth (core/grow_batched.py): incompatible with
+        # anything whose bookkeeping depends on exact one-split-at-a-time
+        # ordering
+        batch_splits = 0
+        if cfg.tree_growth == "batched":
+            if num_forced > 0 or self._cegb_state is not None:
+                raise LightGBMError(
+                    "tree_growth=batched requires exact split ordering; "
+                    "disable forced splits / CEGB or use tree_growth=exact")
+            if cfg.tree_learner in ("voting", "feature"):
+                raise LightGBMError(
+                    "tree_growth=batched supports the serial and data tree "
+                    "learners only (got tree_learner=%s)" % cfg.tree_learner)
+            batch_splits = min(cfg.tree_batch_splits, cfg.num_leaves - 1)
+
         # explicit shard_map data-parallel learner: every device partitions
         # its local row shard and only child histograms cross the mesh
         # (data_parallel_tree_learner.cpp:146-161). Forced splits and CEGB
@@ -344,6 +359,7 @@ class GBDT:
             partition_on_mesh=self._partition_on_mesh,
             vmapped_classes=(self.num_tree_per_iteration > 1
                              and pool_slots == 0),
+            batch_splits=batch_splits,
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
@@ -613,10 +629,19 @@ class GBDT:
                 from ..parallel.mesh import DATA_AXIS
                 tree_spec = jax.tree.map(lambda _: P(),
                                          empty_tree(params.num_leaves))
+                if params.batch_splits > 0:
+                    from ..core.grow_batched import grow_tree_batched
+
+                    def _grow_core(xbj, gj, hj, mj, fm):
+                        return grow_tree_batched(
+                            xbj, gj, hj, mj, meta, fm, params,
+                            axis_name=DATA_AXIS)[:2]
+                else:
+                    def _grow_core(xbj, gj, hj, mj, fm):
+                        return grow_tree(xbj, gj, hj, mj, meta, fm, params,
+                                         axis_name=DATA_AXIS)[:2]
                 grow_sharded = jax.shard_map(
-                    lambda xbj, gj, hj, mj, fm: grow_tree(
-                        xbj, gj, hj, mj, meta, fm, params,
-                        axis_name=DATA_AXIS)[:2],
+                    _grow_core,
                     mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
                                          P(DATA_AXIS), P(DATA_AXIS), P()),
                     out_specs=(tree_spec, P(DATA_AXIS)), check_vma=False)
@@ -625,6 +650,12 @@ class GBDT:
                     t, li = grow_sharded(xb, gk, hk, sample_mask,
                                          feature_mask)
                     return t, li, None
+            elif params.batch_splits > 0:
+                from ..core.grow_batched import grow_tree_batched
+
+                def grow_one(gk, hk, cs):
+                    return grow_tree_batched(xb, gk, hk, sample_mask, meta,
+                                             feature_mask, params)
             else:
                 def grow_one(gk, hk, cs):
                     return grow_tree(xb, gk, hk, sample_mask, meta,

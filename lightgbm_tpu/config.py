@@ -165,6 +165,13 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("tpu_hist_impl", str, "auto", []),
     ("tpu_donate_buffers", bool, True, []),   # donate score/state buffers under jit
     ("mesh_shape", list, [], []),             # e.g. [8] / [4,2]; empty = all devices on one axis
+    # growth strategy: exact = reference leaf-wise best-first; batched =
+    # split the top-tree_batch_splits frontier leaves per sequential step
+    # (approximate best-first; amortizes TPU per-split latency — the same
+    # accuracy stance as the reference GPU learner's documented deviations,
+    # GPU-Performance.rst:132-139). See core/grow_batched.py.
+    ("tree_growth", str, "exact", ["growth_mode"]),
+    ("tree_batch_splits", int, 16, []),
 ]
 
 _CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}
@@ -340,6 +347,12 @@ class Config:
             raise LightGBMError("max_bin should be in (1, 256]")
         if self.num_leaves < 2:
             raise LightGBMError("num_leaves should be >= 2")
+        self.tree_growth = str(self.tree_growth).strip().lower()
+        if self.tree_growth not in ("exact", "batched"):
+            raise LightGBMError("tree_growth should be exact or batched, "
+                                "got %s" % self.tree_growth)
+        if self.tree_batch_splits < 1:
+            raise LightGBMError("tree_batch_splits should be >= 1")
         if self.verbosity >= 0:
             Log.reset_level(self.verbosity)
 

@@ -103,6 +103,10 @@ class GrowParams(NamedTuple):
     # LRU eviction, rebuilding an evicted parent histogram from its rows
     # when that leaf is finally chosen for splitting (the Move/Get dance)
     pool_slots: int = 0
+    # batched-frontier growth (core/grow_batched.py): split up to this many
+    # of the highest-gain frontier leaves per sequential step instead of
+    # exactly one. 0 = exact leaf-wise (the reference's semantics)
+    batch_splits: int = 0
 
 
 class TreeArrays(NamedTuple):
@@ -229,6 +233,55 @@ def _masked_set(arr: jnp.ndarray, idx: jnp.ndarray, val, valid) -> jnp.ndarray:
     return arr.at[idx].set(jnp.where(valid, val, arr[idx]))
 
 
+def expand_hist(hist, sum_g, sum_h, cnt, meta: FeatureMeta,
+                params: "GrowParams", ncols: int) -> jnp.ndarray:
+    """[C, B, 3] column histograms -> [F, Bf, 3] per-feature views.
+
+    EFB: each feature's bins are a contiguous slice of its column
+    (feature_group.h bin_offsets_). A bundled feature's default bin is
+    shared with its bundle-mates, so its entry is rebuilt from leaf
+    totals — the Dataset::FixHistogram idea (dataset.h:411-412).
+    Joint-coded pair columns: a feature's bin-b entry is the MARGINAL
+    over the pair-mate's digit — sum of `pack_partner` joint bins at
+    stride pack_div (for the high digit) or pack_mod (low digit).
+    """
+    b = params.num_bins
+    bf = params.num_feat_bins or b
+    if not params.with_efb:
+        return hist
+    flat = hist.reshape(ncols * b, 3)
+    bidx = jnp.arange(bf, dtype=jnp.int32)[None, :]          # [1, Bf]
+    in_feat = bidx < meta.num_bin[:, None]                   # [F, Bf]
+    idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
+    out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
+        * in_feat[..., None]
+    if params.packed_features:
+        # joint-coded pairs: overwrite just the packed features' rows
+        # with marginals of their column's joint histogram — a [P, Bf,
+        # J] gather-sum over the (static) packed subset, so unpacked
+        # features never pay for the marginalization width
+        pf = jnp.asarray(params.packed_features, jnp.int32)  # [P]
+        jstride = jnp.where(meta.pack_div[pf] > 1, 1,
+                            jnp.maximum(meta.pack_mod[pf], 1))
+        jj = jnp.arange(params.pack_j, dtype=jnp.int32)[None, None, :]
+        bidx_p = jnp.arange(bf, dtype=jnp.int32)[None, :, None]
+        idx_p = (meta.col[pf][:, None, None] * b
+                 + bidx_p * meta.pack_div[pf][:, None, None]
+                 + jj * jstride[:, None, None])              # [P, Bf, J]
+        ok = (jj < meta.pack_partner[pf][:, None, None]) \
+            & (bidx_p < meta.num_bin[pf][:, None, None])
+        out_p = jnp.sum(
+            jnp.take(flat, jnp.clip(idx_p, 0, ncols * b - 1), axis=0)
+            * ok[..., None], axis=2)                         # [P, Bf, 3]
+        out = out.at[pf].set(out_p)
+    totals = jnp.stack([sum_g, sum_h, cnt])                  # [3]
+    is_def = bidx == meta.default_bin[:, None]               # [F, Bf]
+    sum_wo_def = jnp.sum(jnp.where(is_def[..., None], 0.0, out), axis=1)
+    rebuilt = totals[None, :] - sum_wo_def                   # [F, 3]
+    return jnp.where((is_def & meta.bundled[:, None])[..., None],
+                     rebuilt[:, None, :], out)
+
+
 def decode_bundle_value(v: jnp.ndarray, offset: jnp.ndarray,
                         num_bin: jnp.ndarray,
                         default_bin: jnp.ndarray,
@@ -257,15 +310,39 @@ def _bin_go_left(col: jnp.ndarray, threshold: jnp.ndarray,
                  num_bin: jnp.ndarray, default_bin: jnp.ndarray,
                  is_cat: jnp.ndarray, cat_bitset: jnp.ndarray) -> jnp.ndarray:
     """Decision in bin space (Tree::NumericalDecisionInner /
-    CategoricalDecisionInner, tree.h:212-260)."""
+    CategoricalDecisionInner, tree.h:212-260).
+
+    One split (cat_bitset [8], scalar split params) or per-row splits
+    (cat_bitset [N, 8], every param [N] — batched-frontier routing); the
+    missing-value and categorical semantics must stay in exactly one
+    place so exact growth, batched growth, and predict cannot diverge.
+    """
     coli = col.astype(jnp.int32)
     is_missing = jnp.where(
         missing_type == MISSING_NAN, coli == num_bin - 1,
         jnp.where(missing_type == MISSING_ZERO, coli == default_bin, False))
     numerical = jnp.where(is_missing, default_left, coli <= threshold)
-    word = cat_bitset[coli >> 5]
+    if cat_bitset.ndim == 1:
+        word = cat_bitset[coli >> 5]
+    else:
+        word = jnp.take_along_axis(cat_bitset, (coli >> 5)[:, None],
+                                   axis=1)[:, 0]
     categorical = ((word >> (coli & 31).astype(jnp.uint32)) & 1) == 1
     return jnp.where(is_cat, categorical, numerical)
+
+
+def propagate_monotone_bounds(mono, left_output, right_output, p_min, p_max):
+    """Monotone constraint propagation (serial_tree_learner.cpp:790-847):
+    children inherit the parent's output bounds; a monotone split feature
+    additionally pins the shared boundary at the midpoint of the two child
+    outputs. Returns (l_min, l_max, r_min, r_max). Shared by exact and
+    batched growth — the K=1 bit-for-bit parity contract depends on it."""
+    mid = (left_output + right_output) * 0.5
+    l_min = jnp.where(mono < 0, jnp.maximum(p_min, mid), p_min)
+    l_max = jnp.where(mono > 0, jnp.minimum(p_max, mid), p_max)
+    r_min = jnp.where(mono > 0, jnp.maximum(p_min, mid), p_min)
+    r_max = jnp.where(mono < 0, jnp.minimum(p_max, mid), p_max)
+    return l_min, l_max, r_min, r_max
 
 
 def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -310,49 +387,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return h if voting else psum(h)
 
     def expand(hist, sum_g, sum_h, cnt):
-        """[C, B, 3] column histograms -> [F, Bf, 3] per-feature views.
-
-        EFB: each feature's bins are a contiguous slice of its column
-        (feature_group.h bin_offsets_). A bundled feature's default bin is
-        shared with its bundle-mates, so its entry is rebuilt from leaf
-        totals — the Dataset::FixHistogram idea (dataset.h:411-412).
-        Joint-coded pair columns: a feature's bin-b entry is the MARGINAL
-        over the pair-mate's digit — sum of `pack_partner` joint bins at
-        stride pack_div (for the high digit) or pack_mod (low digit).
-        """
-        if not params.with_efb:
-            return hist
-        flat = hist.reshape(ncols * b, 3)
-        bidx = jnp.arange(bf, dtype=jnp.int32)[None, :]          # [1, Bf]
-        in_feat = bidx < meta.num_bin[:, None]                   # [F, Bf]
-        idx = meta.col[:, None] * b + meta.offset[:, None] + bidx
-        out = jnp.take(flat, jnp.clip(idx, 0, ncols * b - 1), axis=0) \
-            * in_feat[..., None]
-        if params.packed_features:
-            # joint-coded pairs: overwrite just the packed features' rows
-            # with marginals of their column's joint histogram — a [P, Bf,
-            # J] gather-sum over the (static) packed subset, so unpacked
-            # features never pay for the marginalization width
-            pf = jnp.asarray(params.packed_features, jnp.int32)  # [P]
-            jstride = jnp.where(meta.pack_div[pf] > 1, 1,
-                                jnp.maximum(meta.pack_mod[pf], 1))
-            jj = jnp.arange(params.pack_j, dtype=jnp.int32)[None, None, :]
-            bidx_p = jnp.arange(bf, dtype=jnp.int32)[None, :, None]
-            idx_p = (meta.col[pf][:, None, None] * b
-                     + bidx_p * meta.pack_div[pf][:, None, None]
-                     + jj * jstride[:, None, None])              # [P, Bf, J]
-            ok = (jj < meta.pack_partner[pf][:, None, None]) \
-                & (bidx_p < meta.num_bin[pf][:, None, None])
-            out_p = jnp.sum(
-                jnp.take(flat, jnp.clip(idx_p, 0, ncols * b - 1), axis=0)
-                * ok[..., None], axis=2)                         # [P, Bf, 3]
-            out = out.at[pf].set(out_p)
-        totals = jnp.stack([sum_g, sum_h, cnt])                  # [3]
-        is_def = bidx == meta.default_bin[:, None]               # [F, Bf]
-        sum_wo_def = jnp.sum(jnp.where(is_def[..., None], 0.0, out), axis=1)
-        rebuilt = totals[None, :] - sum_wo_def                   # [F, 3]
-        return jnp.where((is_def & meta.bundled[:, None])[..., None],
-                         rebuilt[:, None, :], out)
+        return expand_hist(hist, sum_g, sum_h, cnt, meta, params, ncols)
 
     def cegb_gain_penalty(cegb_state, cnt, leaf_mask):
         """[F] CEGB penalty for one candidate leaf
@@ -773,17 +808,10 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
 
-        # monotone constraint propagation (serial_tree_learner.cpp:790-847):
-        # children inherit the parent's output bounds; a monotone split
-        # feature additionally pins the shared boundary at the midpoint of
-        # the two child outputs
         mono = meta.monotone[cur.feature]
-        mid = (cur.left_output + cur.right_output) * 0.5
         p_min, p_max = s.leaf_min[leaf], s.leaf_max[leaf]
-        l_min = jnp.where(mono < 0, jnp.maximum(p_min, mid), p_min)
-        l_max = jnp.where(mono > 0, jnp.minimum(p_max, mid), p_max)
-        r_min = jnp.where(mono > 0, jnp.maximum(p_min, mid), p_min)
-        r_max = jnp.where(mono < 0, jnp.minimum(p_max, mid), p_max)
+        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
+            mono, cur.left_output, cur.right_output, p_min, p_max)
         leaf_min = _masked_set(_masked_set(s.leaf_min, leaf, l_min, valid),
                                right_leaf, r_min, valid)
         leaf_max = _masked_set(_masked_set(s.leaf_max, leaf, l_max, valid),

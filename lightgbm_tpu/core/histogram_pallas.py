@@ -150,3 +150,112 @@ def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
     )(xb_t, vals)
     out = out.reshape(k, fp, hi_n * 16)
     return jnp.moveaxis(out, 0, -1)[:f, :num_bins]           # [F, B, 3]
+
+
+def _hist_slot_kernel(xb_ref, slot_ref, vals_ref, out_ref, *, hi_n: int,
+                      n_slots: int, highest: bool):
+    """One (feature_tile, row_tile) grid cell of the SLOT-EXTENDED digit
+    kernel (batched-frontier growth, core/grow_batched.py): every row
+    carries a slot id in [0, n_slots) — which frontier-leaf child it
+    belongs to this step — and the kernel accumulates a separate [B]
+    histogram per (slot, feature).
+
+    The combined index slot*B + 16*hi + lo factorizes into THREE one-hots;
+    grouping (vals x hi) on the left and (slot x lo) on the right keeps
+    one MXU contraction per feature: [K*Hi, C] @ [C, S*16]. Rows whose
+    value channels are zero (masked / not in any split leaf) contribute
+    nothing regardless of slot id.
+
+    xb_ref: [Ft, C] uint8; slot_ref: [1, C] int32; vals_ref: [K, C] f32;
+    out_ref: [K, Ft, Hi, S*16] f32 (lo is minor so the RHS one-hot needs
+    no in-kernel transpose; the caller reorders to [S, F, B, K]).
+    """
+    r = pl.program_id(1)
+    xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
+    slot = slot_ref[...].astype(jnp.int32)                   # [1, C]
+    vals = vals_ref[...]                                     # [K, C]
+    ft, c = xb.shape
+    k = vals.shape[0]
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (hi_n, c), 0)
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_slots, c), 0)
+    s_eq = iota_s == slot                                    # [S, C]
+    for j in range(ft):
+        x = xb[j:j + 1, :]                                   # [1, C]
+        hi_eq = iota_hi == (x >> 4)                          # [Hi, C]
+        lo_eq = iota_lo == (x & 15)                          # [16, C]
+        a = jnp.where(hi_eq[None, :, :], vals[:, None, :],
+                      0.0).reshape(k * hi_n, c)              # [K*Hi, C]
+        # RHS one-hot of (slot, lo) jointly: column index s*16 + lo
+        eqj = jnp.where(s_eq[:, None, :] & lo_eq[None, :, :], 1.0,
+                        0.0).reshape(n_slots * 16, c)        # [S*16, C]
+        if highest:
+            part = jax.lax.dot_general(
+                a, eqj, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)          # [K*Hi, S*16]
+        else:
+            a_top = a.astype(jnp.bfloat16)
+            a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+            eqj = eqj.astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                a_top, eqj, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            part += jax.lax.dot_general(
+                a_rem, eqj, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        out_ref[:, j, :, :] += part.reshape(k, hi_n, n_slots * 16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "n_slots", "row_tile",
+                                    "feature_tile", "interpret", "highest"))
+def build_histogram_slots(xb: jnp.ndarray, slot: jnp.ndarray,
+                          vals: jnp.ndarray, num_bins: int, n_slots: int,
+                          row_tile: int = 2048, feature_tile: int = 8,
+                          interpret: bool = False,
+                          highest: bool = False) -> jnp.ndarray:
+    """[N, F] uint8 bins + per-row slot ids + [K, N] value channels ->
+    [n_slots, F, B, K] f32 histograms — every slot's histogram in ONE pass
+    over the rows (the multi-leaf step of batched-frontier growth).
+
+    Rows outside every slot must carry zero value channels; their slot id
+    is ignored (clamped into range)."""
+    n, f = xb.shape
+    k = vals.shape[0]
+    hi_n = max(1, (num_bins + 15) // 16)
+
+    f_pad = (-f) % feature_tile
+    n_pad = (-n) % row_tile
+    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad))).astype(jnp.uint8)
+    slot2 = jnp.clip(slot.astype(jnp.int32), 0, n_slots - 1)
+    slot2 = jnp.pad(slot2, (0, n_pad))[None, :]              # [1, N+pad]
+    vals = jnp.pad(vals, ((0, 0), (0, n_pad)))
+    fp = f + f_pad
+
+    kernel = functools.partial(_hist_slot_kernel, hi_n=hi_n,
+                               n_slots=n_slots, highest=highest)
+    out = pl.pallas_call(
+        kernel,
+        grid=(fp // feature_tile, (n + n_pad) // row_tile),
+        in_specs=[
+            pl.BlockSpec((feature_tile, row_tile), lambda i, r: (i, r)),
+            pl.BlockSpec((1, row_tile), lambda i, r: (0, r)),
+            pl.BlockSpec((k, row_tile), lambda i, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((k, feature_tile, hi_n, n_slots * 16),
+                               lambda i, r: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k, fp, hi_n, n_slots * 16),
+                                       jnp.float32),
+        interpret=interpret,
+    )(xb_t, slot2, vals)
+    # [K, F, Hi, S, 16] -> [S, F, B, K]
+    out = out.reshape(k, fp, hi_n, n_slots, 16)
+    out = jnp.transpose(out, (3, 1, 2, 4, 0)).reshape(
+        n_slots, fp, hi_n * 16, k)
+    return out[:, :f, :num_bins]

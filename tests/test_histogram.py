@@ -193,3 +193,31 @@ def test_sort_placement_gate_is_allow_list(monkeypatch, fake_backend,
     assert sort_placement_profitable("pallas", vmapped=False) \
         == plain_expected
     assert sort_placement_profitable("pallas_interpret", vmapped=False)
+
+
+def test_slot_kernel_matches_per_slot_scatter():
+    """The slot-extended digit kernel (batched-frontier growth) must equal
+    building each slot's histogram separately with the scatter reference."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.histogram import build_histogram
+    from lightgbm_tpu.core.histogram_pallas import build_histogram_slots
+    r = np.random.RandomState(21)
+    n, f, b, s = 1100, 6, 256, 8
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = np.abs(r.randn(n)).astype(np.float32)
+    m = (r.rand(n) > 0.2).astype(np.float32)
+    slot = r.randint(0, s, n).astype(np.int32)
+    vals = jnp.stack([jnp.asarray(g * m), jnp.asarray(h * m),
+                      jnp.asarray(m)], axis=0)
+    for highest in (False, True):
+        out = np.asarray(build_histogram_slots(
+            jnp.asarray(xb), jnp.asarray(slot), vals, num_bins=b, n_slots=s,
+            interpret=True, highest=highest))
+        assert out.shape == (s, f, b, 3)
+        for si in range(s):
+            msk = m * (slot == si)
+            ref = np.asarray(build_histogram(
+                jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h),
+                jnp.asarray(msk), num_bins=b, impl="scatter"))
+            np.testing.assert_allclose(out[si], ref, rtol=1e-4, atol=1e-3)
