@@ -495,7 +495,7 @@ class Booster:
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting round (basic.py:1843). Returns True if stopped."""
         if train_set is not None and train_set is not self._train_set:
-            raise LightGBMError("Replacing train_set is not supported yet")
+            self.reset_training_data(train_set)
         if fobj is None:
             return self._impl.train_one_iter()
         # custom objective path (__boost, basic.py:1891)
@@ -604,10 +604,25 @@ class Booster:
         if hasattr(data, "dtypes") and hasattr(data, "columns") \
                 and self.pandas_categorical is not None:
             data = _pandas_frame_to_array(data, self.pandas_categorical)[0]
-        X = _to_2d_float(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 \
                 else None
+        if hasattr(data, "toarray"):
+            # sparse input: densify in bounded row blocks (~128 MB of f64),
+            # never the whole matrix (PredictForCSR streams rows the same
+            # way; an Allstate-shaped 13.2M x 4228 CSR would otherwise
+            # materialize ~450 GB). Each block is one device call.
+            block = max(256, (1 << 24) // max(int(data.shape[1]), 1))
+            if data.shape[0] > block:
+                mat = data.tocsr()
+                outs = [self.predict(
+                            mat[lo:lo + block].toarray(),
+                            num_iteration=num_iteration,
+                            raw_score=raw_score, pred_leaf=pred_leaf,
+                            pred_contrib=pred_contrib, **kwargs)
+                        for lo in range(0, mat.shape[0], block)]
+                return np.concatenate(outs, axis=0)
+        X = _to_2d_float(data)
         if pred_contrib:
             return self._impl_predict_contrib(X, num_iteration)
         return self._impl.predict(
@@ -620,6 +635,64 @@ class Booster:
     def _impl_predict_contrib(self, X, num_iteration):
         from .core.shap import predict_contrib
         return predict_contrib(self._impl, X, num_iteration)
+
+    def reset_training_data(self, train_set: Dataset) -> "Booster":
+        """Swap the training dataset under the current model
+        (LGBM_BoosterResetTrainingData -> GBDT::ResetTrainingData,
+        gbdt.cpp:622-660): bin mappers must align with the old data, the
+        model is kept, and train scores are recomputed by replaying every
+        tree on the new binned features."""
+        check(self._impl is not None, "no training state to reset")
+        check(isinstance(train_set, Dataset),
+              "Training data should be Dataset instance")
+        old_binned = self._train_set.construct()._binned \
+            if self._train_set is not None else None
+        if train_set._binned is None:
+            if train_set.reference is None and self._train_set is not None:
+                train_set.reference = self._train_set
+            train_set.params = {**(train_set.params or {}), **self.params}
+        train_set.construct()
+        if old_binned is not None:
+            # CheckAlign (gbdt.cpp:624-626): identical bin mappers or fatal
+            check(train_set._binned.get_feature_infos()
+                  == old_binned.get_feature_infos(),
+                  "Cannot reset training data: new training data has "
+                  "different bin mappers")
+
+        import jax.numpy as jnp
+        old = self._impl
+        models = copy.deepcopy(old.models)   # materializes pending work
+        new_impl = create_boosting(
+            self.config, train_set._binned, create_objective(self.config),
+            [m for m in (create_metric(n, self.config)
+                         for n in getattr(self, "_metric_names", [])) if m])
+        new_impl._models = models
+        new_impl.iter_ = old.iter_
+        new_impl.num_init_iteration = getattr(old, "num_init_iteration", 0)
+        new_impl.boost_from_average_done = True
+        offs = getattr(old, "init_score_offsets", None)
+        if offs is not None and np.any(np.asarray(offs) != 0):
+            new_impl.scores = new_impl.scores + jnp.asarray(
+                np.asarray(offs, np.float32))[None, :]
+            new_impl.init_score_offsets = np.asarray(offs, np.float32)
+        k = max(new_impl.num_tree_per_iteration, 1)
+        scores = new_impl.scores
+        for i, ht in enumerate(models):
+            leaf = new_impl._replay_leaves_binned(ht, new_impl.xb)
+            scores = scores.at[:, i % k].add(
+                jnp.asarray(ht.leaf_value.astype(np.float32))[leaf])
+        new_impl.scores = scores
+        # validation sets survive the swap (the reference keeps its
+        # valid_score_updaters; add_valid_data replays the model on each)
+        for vset, vname in zip(self._valid_sets, self.name_valid_sets):
+            mets = [m for m in (create_metric(n, self.config)
+                                for n in getattr(self, "_metric_names", []))
+                    if m]
+            new_impl.add_valid_data(vset.construct()._binned, mets)
+        self._impl = new_impl
+        self._objective = new_impl.objective
+        self._train_set = train_set
+        return self
 
     def refit(self, data, label, decay_rate: float = 0.9, weight=None,
               group=None, **kwargs) -> "Booster":
@@ -636,8 +709,13 @@ class Booster:
               "Cannot refit: no trained model")
         check(self._objective is not None,
               "Cannot refit a model trained with a custom objective")
-        X = _to_2d_float(data)
-        n = X.shape[0]
+        sparse_in = hasattr(data, "toarray") and not hasattr(data, "dtypes")
+        if sparse_in:
+            data = data.tocsr()
+            n = int(data.shape[0])
+        else:
+            X = _to_2d_float(data)
+            n = X.shape[0]
         k = self._impl.num_tree_per_iteration
         models = self._impl.models
 
@@ -652,7 +730,22 @@ class Booster:
         cfg = self.config
         l1, l2, mds = cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
 
-        xj = jnp.asarray(X, jnp.float32)
+        if sparse_in:
+            # bounded-block leaf routing: never materialize the full dense
+            # matrix (the sparse-predict contract; PredictForCSR streams)
+            blk = max(256, (1 << 24) // max(int(data.shape[1]), 1))
+
+            def leaves_of(pt):
+                return np.concatenate([
+                    np.asarray(tree_mod.predict_tree_leaves_raw(
+                        pt, jnp.asarray(data[lo:lo + blk].toarray(),
+                                        jnp.float32)))
+                    for lo in range(0, n, blk)])
+        else:
+            xj = jnp.asarray(X, jnp.float32)
+
+            def leaves_of(pt):
+                return np.asarray(tree_mod.predict_tree_leaves_raw(pt, xj))
         scores = np.zeros((n, k), np.float32)
         g = h = None
         new_trees = []
@@ -669,7 +762,7 @@ class Booster:
             pt = jax.tree.map(jnp.asarray,
                               ht.predict_table(max(len(ht.split_leaf), 1),
                                                max(len(ht.leaf_value), 1)))
-            leaves = np.asarray(tree_mod.predict_tree_leaves_raw(pt, xj))
+            leaves = leaves_of(pt)
             sg = np.bincount(leaves, weights=g[:, c].astype(np.float64),
                              minlength=nl)
             sh = np.bincount(leaves, weights=h[:, c].astype(np.float64),

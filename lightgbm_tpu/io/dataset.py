@@ -652,8 +652,12 @@ class BinnedDataset:
             arrays["query_boundaries"] = self.metadata.query_boundaries
         if self.metadata.init_score is not None:
             arrays["init_score"] = self.metadata.init_score
-        np.savez_compressed(path, __meta__=np.frombuffer(
-            json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+        # write through a file handle: savez appends ".npz" to bare paths,
+        # but the caller's filename (e.g. via LGBM_DatasetSaveBinary) is a
+        # contract
+        with open(path, "wb") as fh:
+            np.savez_compressed(fh, __meta__=np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8), **arrays)
 
     @classmethod
     def load_binary(cls, path: str) -> "BinnedDataset":

@@ -134,7 +134,19 @@ int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines);
 int LGBM_NetworkFree();
 
-/* ---- explicit not-supported stubs (always -1 + error message) ---- */
+/* ---- streaming construction (CreateByReference / CreateFromSampledColumn
+ * preallocate; PushRows* fill; the first consumer finalizes — FinishLoad
+ * fires when start_row + nrow == num_total_row, c_api.h:58-233) ---- */
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out);
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
 int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
                          int data_type, int32_t nrow, int32_t ncol,
                          int32_t start_row);
@@ -150,6 +162,93 @@ int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
                               const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+
+/* ---- dataset accessors ---- */
+/* out_ptr points into storage owned by the dataset handle; valid until
+ * the handle is freed. out_type is a C_API_DTYPE_* code. group comes
+ * back as cumulative query boundaries (num_queries + 1 entries). */
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type);
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
+int LGBM_DatasetUpdateParam(DatasetHandle handle, const char* parameters);
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source);
+/* extended GetFeatureNames: `len` slots of `buffer_len` bytes each;
+ * reports the true counts and errors on under-allocation instead of
+ * overrunning (modern upstream signature). */
+int LGBM_DatasetGetFeatureNamesSafe(DatasetHandle handle, int len,
+                                    int* num_feature_names, int buffer_len,
+                                    int* out_buffer_len,
+                                    char** feature_names);
+
+/* ---- booster extras ---- */
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs);  /* 128-byte slots */
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol);
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len);
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int ncol, int is_row_major,
+                                       int predict_type, int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int num_iteration,
+                               const char* parameter, int64_t* out_len,
+                               double* out_result);
+void LGBM_SetLastError(const char* msg);
+
+/* ---- explicit not-supported stubs (always -1 + error message) ---- */
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const DatasetHandle reference,
+                                  DatasetHandle* out);
 int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
                                   void* reduce_scatter_ext_fun,
                                   void* allgather_ext_fun);

@@ -655,31 +655,508 @@ int LGBM_NetworkFree() {
   API_END();
 }
 
+/* ------------------------------------------- streaming construction */
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_by_reference",
+      Py_BuildValue("(OL)", reinterpret_cast<PyObject*>(reference),
+                    static_cast<long long>(num_total_row)));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>(r);
+  API_END();
+}
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* cols = PyList_New(ncol);
+  PyObject* idxs = PyList_New(ncol);
+  PyObject* counts = PyList_New(ncol);
+  for (int32_t j = 0; j < ncol; ++j) {
+    int cnt = num_per_col[j];
+    PyObject* c = (cnt > 0 && sample_data[j])
+        ? mv_from(sample_data[j], static_cast<Py_ssize_t>(cnt) * 8)
+        : (Py_INCREF(Py_None), Py_None);
+    PyObject* ix = (cnt > 0 && sample_indices[j])
+        ? mv_from(sample_indices[j], static_cast<Py_ssize_t>(cnt) * 4)
+        : (Py_INCREF(Py_None), Py_None);
+    PyList_SET_ITEM(cols, j, c);
+    PyList_SET_ITEM(idxs, j, ix);
+    PyList_SET_ITEM(counts, j, PyLong_FromLong(cnt));
+  }
+  PyObject* r = call_impl(
+      "dataset_create_from_sampled_column",
+      Py_BuildValue("(NNNiis)", cols, idxs, counts, num_sample_row,
+                    num_total_row, parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>(r);
+  API_END();
+}
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_push_rows",
+      Py_BuildValue("(ONiiii)", reinterpret_cast<PyObject*>(dataset),
+                    mv_from(data, static_cast<Py_ssize_t>(nrow) * ncol *
+                                      dtype_size(data_type)),
+                    data_type, nrow, ncol, start_row));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_push_rows_by_csr",
+      Py_BuildValue("(ONiNNiLLLL)", reinterpret_cast<PyObject*>(dataset),
+                    mv_from(indptr, nindptr * dtype_size(indptr_type)),
+                    indptr_type, mv_from(indices, nelem * 4),
+                    mv_from(data, nelem * dtype_size(data_type)), data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    static_cast<long long>(start_row)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* ref = reference == nullptr
+      ? (Py_INCREF(Py_None), Py_None)
+      : (Py_INCREF(reinterpret_cast<PyObject*>(reference)),
+         reinterpret_cast<PyObject*>(reference));
+  PyObject* r = call_impl(
+      "dataset_from_csc",
+      Py_BuildValue("(NiNNiLLLsN)",
+                    mv_from(col_ptr, ncol_ptr * dtype_size(col_ptr_type)),
+                    col_ptr_type, mv_from(indices, nelem * 4),
+                    mv_from(data, nelem * dtype_size(data_type)), data_type,
+                    static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row),
+                    parameters ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>(r);
+  API_END();
+}
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* mvs = PyList_New(nmat);
+  PyObject* rows = PyList_New(nmat);
+  for (int32_t m = 0; m < nmat; ++m) {
+    PyList_SET_ITEM(mvs, m,
+                    mv_from(data[m], static_cast<Py_ssize_t>(nrow[m]) *
+                                         ncol * dtype_size(data_type)));
+    PyList_SET_ITEM(rows, m, PyLong_FromLong(nrow[m]));
+  }
+  PyObject* ref = reference == nullptr
+      ? (Py_INCREF(Py_None), Py_None)
+      : (Py_INCREF(reinterpret_cast<PyObject*>(reference)),
+         reinterpret_cast<PyObject*>(reference));
+  PyObject* r = call_impl(
+      "dataset_from_mats",
+      Py_BuildValue("(NiNiisN)", mvs, data_type, rows, ncol, is_row_major,
+                    parameters ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>(r);
+  API_END();
+}
+
+/* ------------------------------------------------- dataset accessors */
+
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_field",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    field_name ? field_name : ""));
+  if (r == nullptr) return -1;
+  int code = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  PyObject* arr = PyTuple_GetItem(r, 1);
+  *out_type = code;
+  if (arr == Py_None) {
+    *out_len = 0;
+    *out_ptr = nullptr;
+    Py_DECREF(r);
+    return 0;
+  }
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_SIMPLE) != 0) {
+    capture_py_error();
+    Py_DECREF(r);
+    return -1;
+  }
+  /* the array is cached on the dataset object Python-side, so the pointer
+   * outlives this view (and this call) for the handle's lifetime */
+  *out_ptr = view.buf;
+  *out_len = static_cast<int>(view.len / dtype_size(code));
+  PyBuffer_Release(&view);
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_save_binary",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    filename ? filename : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_subset",
+      Py_BuildValue("(ONis)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(used_row_indices,
+                            static_cast<Py_ssize_t>(num_used_row_indices)
+                                * 4),
+                    num_used_row_indices, parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>(r);
+  API_END();
+}
+
+int LGBM_DatasetUpdateParam(DatasetHandle handle, const char* parameters) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_update_param",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_dump_text",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    filename ? filename : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_add_features_from",
+      Py_BuildValue("(OO)", reinterpret_cast<PyObject*>(target),
+                    reinterpret_cast<PyObject*>(source)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* Extended-signature variant of LGBM_DatasetGetFeatureNames: the caller
+ * states how many slots it allocated and how long each slot is, so
+ * under-allocation is an error instead of an overrun (the modern upstream
+ * signature; the v2.2.4-compat entry point above keeps the historical
+ * 128-byte-slot contract). */
+int LGBM_DatasetGetFeatureNamesSafe(DatasetHandle handle, int len,
+                                    int* num_feature_names, int buffer_len,
+                                    int* out_buffer_len,
+                                    char** feature_names) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_feature_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *num_feature_names = static_cast<int>(n);
+  *out_buffer_len = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t sl = 0;
+    const char* s = PyUnicode_AsUTF8AndSize(PyList_GetItem(r, i), &sl);
+    if (static_cast<int>(sl) + 1 > *out_buffer_len)
+      *out_buffer_len = static_cast<int>(sl) + 1;
+    if (i < len && feature_names != nullptr && buffer_len > 0) {
+      std::strncpy(feature_names[i], s ? s : "",
+                   static_cast<size_t>(buffer_len) - 1);
+      feature_names[i][buffer_len - 1] = '\0';
+    }
+  }
+  Py_DECREF(r);
+  if (n > len) {
+    g_last_error = "feature_names has fewer slots than num_feature";
+    return -1;
+  }
+  if (*out_buffer_len > buffer_len) {
+    g_last_error = "a feature name is longer than buffer_len "
+                   "(required length is in out_buffer_len)";
+    return -1;
+  }
+  API_END();
+}
+
+/* --------------------------------------------------- booster extras */
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_feature_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    std::strncpy(out_strs[i], s ? s : "", 127);
+    out_strs[i][127] = '\0';
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_reset_training_data",
+      Py_BuildValue("(OO)", reinterpret_cast<PyObject*>(handle),
+                    reinterpret_cast<PyObject*>(train_data)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_refit_with_leaves",
+      Py_BuildValue("(ONii)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(leaf_preds,
+                            static_cast<Py_ssize_t>(nrow) * ncol * 4),
+                    nrow, ncol));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_shuffle_models",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                    start_iter, end_iter));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_set_leaf_value",
+      Py_BuildValue("(Oiid)", reinterpret_cast<PyObject*>(handle),
+                    tree_idx, leaf_idx, val));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_num_predict",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), data_idx));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int64_t>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_predict",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), data_idx));
+  if (r == nullptr) return -1;
+  int rc = copy_bytes_out(r, out_result, out_len);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_calc_num_predict",
+      Py_BuildValue("(Oiii)", reinterpret_cast<PyObject*>(handle), num_row,
+                    predict_type, num_iteration));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int64_t>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_file",
+      Py_BuildValue("(Osiiiss)", reinterpret_cast<PyObject*>(handle),
+                    data_filename ? data_filename : "", data_has_header,
+                    predict_type, num_iteration, parameter ? parameter : "",
+                    result_filename ? result_filename : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_csc",
+      Py_BuildValue("(ONiNNiLLLiis)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(col_ptr, ncol_ptr * dtype_size(col_ptr_type)),
+                    col_ptr_type, mv_from(indices, nelem * 4),
+                    mv_from(data, nelem * dtype_size(data_type)), data_type,
+                    static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row), predict_type,
+                    num_iteration, parameter ? parameter : ""));
+  if (r == nullptr) return -1;
+  int rc = copy_bytes_out(r, out_result, out_len);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
+/* SingleRow fast paths: the reference builds a one-row Predictor with
+ * cached buffers (src/c_api.cpp:273-363); here prediction is one jitted
+ * device call either way, so these delegate to the batch entry points
+ * with nrow == 1 — same contract, no second code path to drift. */
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int ncol, int is_row_major,
+                                       int predict_type, int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem, num_col,
+                                   predict_type, num_iteration, parameter,
+                                   out_len, out_result);
+}
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int num_iteration,
+                               const char* parameter, int64_t* out_len,
+                               double* out_result) {
+  API_BEGIN();
+  /* rows arrive as nrow separate pointers; assemble one contiguous
+   * row-major block and reuse the mat path */
+  Py_ssize_t esz = dtype_size(data_type);
+  std::vector<char> block(static_cast<size_t>(nrow) * ncol * esz);
+  for (int32_t i = 0; i < nrow; ++i) {
+    std::memcpy(block.data() + static_cast<size_t>(i) * ncol * esz, data[i],
+                static_cast<size_t>(ncol) * esz);
+  }
+  PyObject* r = call_impl(
+      "booster_predict_mat",
+      Py_BuildValue("(ONiiiiiis)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(block.data(),
+                            static_cast<Py_ssize_t>(block.size())),
+                    data_type, nrow, ncol, 1, predict_type, num_iteration,
+                    parameter ? parameter : ""));
+  if (r == nullptr) return -1;
+  int rc = copy_bytes_out(r, out_result, out_len);
+  Py_DECREF(r);
+  if (rc != 0) return -1;
+  API_END();
+}
+
+void LGBM_SetLastError(const char* msg) {
+  g_last_error = msg ? msg : "";
+}
+
 /* Explicit not-supported surface: these reference entry points have no
- * analog in this runtime (datasets bin on device in one shot; the
- * collective backend is XLA over ICI/DCN, not injectable socket
- * functions). They fail loudly instead of linking away. */
+ * analog in this runtime (the collective backend is XLA over ICI/DCN,
+ * not injectable socket functions; callback-driven CSR iteration has no
+ * useful embedding across the C/Python boundary). They fail loudly
+ * instead of linking away. */
 static int not_supported(const char* what) {
   g_last_error = std::string(what) +
       " is not supported by lightgbm_tpu (see native/BINDINGS.md)";
   return -1;
 }
 
-int LGBM_DatasetPushRows(DatasetHandle, const void*, int, int32_t, int32_t,
-                         int32_t) {
-  return not_supported("LGBM_DatasetPushRows");
-}
-
-int LGBM_DatasetPushRowsByCSR(DatasetHandle, const void*, int,
-                              const int32_t*, const void*, int, int64_t,
-                              int64_t, int64_t, int64_t) {
-  return not_supported("LGBM_DatasetPushRowsByCSR");
-}
-
-int LGBM_DatasetCreateFromCSC(const void*, int, const int32_t*, const void*,
-                              int, int64_t, int64_t, int64_t, const char*,
-                              const DatasetHandle, DatasetHandle*) {
-  return not_supported("LGBM_DatasetCreateFromCSC");
+int LGBM_DatasetCreateFromCSRFunc(void*, int, int64_t, const char*,
+                                  const DatasetHandle, DatasetHandle*) {
+  return not_supported("LGBM_DatasetCreateFromCSRFunc");
 }
 
 int LGBM_NetworkInitWithFunctions(int, int, void*, void*) {

@@ -109,6 +109,35 @@ int main(void) {
     }
   }
 
+  /* single-row fast path must agree with row 0 of the batch call */
+  double one = 0.0;
+  int64_t one_len = 0;
+  CHECK(LGBM_BoosterPredictForMatSingleRow(bst, X, C_API_DTYPE_FLOAT64, f,
+                                           1, C_API_PREDICT_RAW_SCORE, -1,
+                                           "", &one_len, &one));
+  if (one_len != 1 || one != preds[0]) {
+    fprintf(stderr, "FAIL single-row: len=%lld %f vs %f\n",
+            (long long)one_len, one, preds[0]);
+    return 1;
+  }
+
+  /* GetPredict returns the converted training scores */
+  int64_t np_len = 0;
+  CHECK(LGBM_BoosterGetNumPredict(bst, 0, &np_len));
+  if (np_len != n) {
+    fprintf(stderr, "FAIL GetNumPredict: %lld\n", (long long)np_len);
+    return 1;
+  }
+  double* train_pred = (double*)malloc(sizeof(double) * n);
+  CHECK(LGBM_BoosterGetPredict(bst, 0, &np_len, train_pred));
+  for (int i = 0; i < n; ++i) {
+    if (train_pred[i] < 0.0 || train_pred[i] > 1.0) {
+      fprintf(stderr, "FAIL GetPredict range at %d: %f\n", i,
+              train_pred[i]);
+      return 1;
+    }
+  }
+
   CHECK(LGBM_BoosterFree(bst));
   CHECK(LGBM_BoosterFree(bst2));
   CHECK(LGBM_DatasetFree(ds));

@@ -226,3 +226,74 @@ def test_get_split_value_histogram():
     assert hist.sum() > 0 and len(edges) == len(hist) + 1
     rows = bst.get_split_value_histogram(0, xgboost_style=True)
     assert rows.ndim == 2 and rows.shape[1] == 2
+
+
+def test_sparse_predict_blocks_not_densified():
+    """Sparse predict streams bounded row blocks (PredictForCSR semantics,
+    c_api.cpp) — results identical to dense, full matrix never
+    materialized. The shape forces multiple blocks (block = 2^24 / F)."""
+    from scipy import sparse as sp
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(11)
+    n, f = 3000, 6000                      # block ~= 2796 -> 2 blocks
+    S = sp.random(n, f, density=0.01, random_state=3, format="csr",
+                  data_rvs=lambda k: rng.randn(k))
+    y = (np.asarray(S[:, 0].todense()).ravel()
+         + np.asarray(S[:, 1].todense()).ravel() > 0).astype(np.float64)
+    dtrain = lgb.Dataset(S[:2000], y[:2000], free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, dtrain, num_boost_round=5)
+    p_sparse = bst.predict(S, raw_score=True)
+    p_dense = bst.predict(np.asarray(S.todense()), raw_score=True)
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-7, atol=1e-7)
+    # leaf prediction blocks identically
+    l_sparse = bst.predict(S[:1000], pred_leaf=True)
+    l_dense = bst.predict(np.asarray(S[:1000].todense()), pred_leaf=True)
+    np.testing.assert_array_equal(l_sparse, l_dense)
+
+
+def test_sparse_refit_matches_dense_refit():
+    from scipy import sparse as sp
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(13)
+    n, f = 2500, 6000
+    S = sp.random(n, f, density=0.01, random_state=5, format="csr",
+                  data_rvs=lambda k: rng.randn(k))
+    y = (np.asarray(S.sum(axis=1)).ravel() > 0).astype(np.float64)
+    dtrain = lgb.Dataset(S, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, dtrain, num_boost_round=4)
+    r_sparse = bst.refit(S, y, decay_rate=0.5)
+    r_dense = bst.refit(np.asarray(S.todense()), y, decay_rate=0.5)
+    np.testing.assert_allclose(
+        r_sparse.predict(np.asarray(S[:200].todense()), raw_score=True),
+        r_dense.predict(np.asarray(S[:200].todense()), raw_score=True),
+        rtol=1e-7, atol=1e-7)
+
+
+def test_reset_training_data_keeps_valid_sets():
+    """GBDT::ResetTrainingData (gbdt.cpp:622-660): the model and the
+    registered validation sets survive a train-set swap."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(21)
+    X = rng.randn(1200, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    dtrain = lgb.Dataset(X[:800], y[:800], free_raw_data=False)
+    dvalid = lgb.Dataset(X[800:], y[800:], reference=dtrain,
+                         free_raw_data=False)
+    bst = lgb.Booster(params={"objective": "binary", "metric": "auc",
+                              "verbosity": -1}, train_set=dtrain)
+    bst.add_valid(dvalid, "v0")
+    for _ in range(4):
+        bst.update()
+    ev_before = dict((m, v) for _, m, v, _ in bst.eval_valid())
+
+    X2 = rng.randn(900, 6)
+    y2 = (X2[:, 0] + X2[:, 1] > 0).astype(np.float64)
+    dtrain2 = lgb.Dataset(X2, y2, reference=dtrain, free_raw_data=False)
+    bst.reset_training_data(dtrain2)
+    # valid evaluation still works and reflects the same (kept) model
+    ev_after = dict((m, v) for _, m, v, _ in bst.eval_valid())
+    assert abs(ev_before["auc"] - ev_after["auc"]) < 1e-6
+    bst.update()          # training continues on the new data
+    assert dict((m, v) for _, m, v, _ in bst.eval_valid())["auc"] > 0.8

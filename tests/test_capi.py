@@ -208,3 +208,322 @@ def test_capi_extended_introspection(lib_path):
     assert bufs[0].value.decode().startswith("Column_")
     assert lib.LGBM_BoosterFree(bst) == 0
     assert lib.LGBM_DatasetFree(ds) == 0
+
+
+def test_capi_round3_surface(lib_path, tmp_path):
+    """The 20 functions added in round 3 (GetField, SaveBinary, GetSubset,
+    streaming push construction, refit/reset, predict variants,
+    introspection) — reference spec c_api.h:49-958."""
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(7)
+    n, f = 400, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, b"max_bin=63",
+        None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0) == 0
+
+    # --- GetField returns a live pointer into handle-owned storage
+    out_len = ctypes.c_int(0)
+    out_ptr = ctypes.c_void_p()
+    out_type = ctypes.c_int(0)
+    assert lib.LGBM_DatasetGetField(
+        ds, b"label", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)) == 0, lib.LGBM_GetLastError()
+    assert out_len.value == n and out_type.value == 0
+    got = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_float)), shape=(n,))
+    np.testing.assert_allclose(got, y)
+
+    # --- SaveBinary + reload through the file-create path
+    binpath = str(tmp_path / "train.npz.bin")
+    assert lib.LGBM_DatasetSaveBinary(ds, binpath.encode()) == 0, \
+        lib.LGBM_GetLastError()
+    assert os.path.getsize(binpath) > 0
+
+    # --- GetSubset
+    idx = np.arange(100, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    assert lib.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 100, b"",
+        ctypes.byref(sub)) == 0, lib.LGBM_GetLastError()
+    nd = ctypes.c_int32(0)
+    assert lib.LGBM_DatasetGetNumData(sub, ctypes.byref(nd)) == 0
+    assert nd.value == 100
+
+    # --- UpdateParam / DumpText
+    assert lib.LGBM_DatasetUpdateParam(ds, b"data_random_seed=5") == 0
+    txt = str(tmp_path / "dump.txt")
+    assert lib.LGBM_DatasetDumpText(sub, txt.encode()) == 0
+    assert os.path.getsize(txt) > 0
+
+    # --- GetFeatureNamesSafe reports true counts and rejects short arrays
+    nfn = ctypes.c_int(0)
+    obl = ctypes.c_int(0)
+    slots = (ctypes.c_char_p * f)(
+        *[ctypes.cast(ctypes.create_string_buffer(64), ctypes.c_char_p)
+          for _ in range(f)])
+    assert lib.LGBM_DatasetGetFeatureNamesSafe(
+        ds, f, ctypes.byref(nfn), 64, ctypes.byref(obl),
+        slots) == 0, lib.LGBM_GetLastError()
+    assert nfn.value == f and obl.value > 1
+    assert lib.LGBM_DatasetGetFeatureNamesSafe(
+        ds, 1, ctypes.byref(nfn), 64, ctypes.byref(obl), slots) == -1
+    # buffer too short for a name is an error, not silent truncation
+    assert lib.LGBM_DatasetGetFeatureNamesSafe(
+        ds, f, ctypes.byref(nfn), 3, ctypes.byref(obl), slots) == -1
+
+    # --- train a booster for the booster-side surface
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int(0)
+    for _ in range(6):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # --- GetFeatureNames (booster)
+    bslots = (ctypes.c_char_p * f)(
+        *[ctypes.cast(ctypes.create_string_buffer(128), ctypes.c_char_p)
+          for _ in range(f)])
+    bn = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetFeatureNames(
+        bst, ctypes.byref(bn), bslots) == 0
+    assert bn.value == f
+
+    # --- CalcNumPredict / GetNumPredict / GetPredict
+    cnt = ctypes.c_int64(0)
+    assert lib.LGBM_BoosterCalcNumPredict(bst, 10, 0, -1,
+                                          ctypes.byref(cnt)) == 0
+    assert cnt.value == 10
+    assert lib.LGBM_BoosterCalcNumPredict(bst, 10, 2, -1,
+                                          ctypes.byref(cnt)) == 0
+    assert cnt.value == 60          # leaf: nrow * k * iters
+    assert lib.LGBM_BoosterGetNumPredict(bst, 0, ctypes.byref(cnt)) == 0
+    assert cnt.value == n
+    preds = np.zeros(n, np.float64)
+    assert lib.LGBM_BoosterGetPredict(
+        bst, 0, ctypes.byref(cnt),
+        preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert cnt.value == n
+    assert 0.0 <= preds.min() and preds.max() <= 1.0       # sigmoided
+
+    # --- single-row predict (mat + csr) matches batch row 0
+    out_len64 = ctypes.c_int64(0)
+    batch0 = np.zeros(1, np.float64)
+    assert lib.LGBM_BoosterPredictForMatSingleRow(
+        bst, X[:1].ctypes.data_as(ctypes.c_void_p), 1, f, 1, 0, -1, b"",
+        ctypes.byref(out_len64),
+        batch0.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    full = np.zeros(n, np.float64)
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, -1, b"",
+        ctypes.byref(out_len64),
+        full.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(batch0[0], full[0], rtol=1e-12)
+
+    from scipy.sparse import csr_matrix
+    row = csr_matrix(X[:1])
+    srow = np.zeros(1, np.float64)
+    lib.LGBM_BoosterPredictForCSRSingleRow.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double)]
+    assert lib.LGBM_BoosterPredictForCSRSingleRow(
+        bst, row.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        2, row.indices.astype(np.int32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)),
+        row.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        2, row.nnz, f, 0, -1, b"", ctypes.byref(out_len64),
+        srow.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(srow[0], full[0], rtol=1e-6)
+
+    # --- PredictForMats (array of row pointers)
+    rows = (ctypes.c_void_p * 3)(*[
+        X[i:i + 1].ctypes.data_as(ctypes.c_void_p) for i in range(3)])
+    three = np.zeros(3, np.float64)
+    assert lib.LGBM_BoosterPredictForMats(
+        bst, rows, 1, 3, f, 0, -1, b"", ctypes.byref(out_len64),
+        three.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(three, full[:3], rtol=1e-12)
+
+    # --- PredictForCSC
+    from scipy.sparse import csc_matrix
+    C = csc_matrix(X[:50])
+    csc_out = np.zeros(50, np.float64)
+    lib.LGBM_BoosterPredictForCSC.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double)]
+    assert lib.LGBM_BoosterPredictForCSC(
+        bst, C.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+        C.indices.astype(np.int32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)),
+        C.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        f + 1, C.nnz, 50, 0, -1, b"", ctypes.byref(out_len64),
+        csc_out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0, \
+        lib.LGBM_GetLastError()
+    np.testing.assert_allclose(csc_out, full[:50], rtol=1e-6)
+
+    # --- PredictForFile
+    datafile = str(tmp_path / "pred_in.csv")
+    np.savetxt(datafile, np.column_stack([y[:20], X[:20]]), delimiter=",")
+    result = str(tmp_path / "pred_out.txt")
+    assert lib.LGBM_BoosterPredictForFile(
+        bst, datafile.encode(), 0, 0, -1, b"", result.encode()) == 0, \
+        lib.LGBM_GetLastError()
+    got_file = np.loadtxt(result)
+    np.testing.assert_allclose(got_file, full[:20], rtol=1e-5, atol=1e-6)
+
+    # --- SetLeafValue / Refit / ShuffleModels / ResetTrainingData
+    assert lib.LGBM_BoosterSetLeafValue(
+        bst, 0, 0, ctypes.c_double(0.25)) == 0
+    lv = ctypes.c_double(0)
+    assert lib.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(lv)) == 0
+    assert abs(lv.value - 0.25) < 1e-12
+
+    nmodels = ctypes.c_int(0)
+    assert lib.LGBM_BoosterNumberOfTotalModel(
+        bst, ctypes.byref(nmodels)) == 0
+    leaf_preds = np.zeros(n * nmodels.value, np.float64)
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 2, -1, b"",
+        ctypes.byref(out_len64),
+        leaf_preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    lp32 = np.ascontiguousarray(
+        leaf_preds.reshape(n, nmodels.value).astype(np.int32))
+    assert lib.LGBM_BoosterRefit(
+        bst, lp32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+        nmodels.value) == 0, lib.LGBM_GetLastError()
+    assert lib.LGBM_BoosterShuffleModels(bst, 0, -1) == 0
+
+    ds2 = ctypes.c_void_p()
+    X2 = rng.randn(300, f)
+    y2 = (X2[:, 0] + X2[:, 1] > 0).astype(np.float32)
+    assert lib.LGBM_DatasetCreateFromMat(
+        X2.ctypes.data_as(ctypes.c_void_p), 1, 300, f, 1, b"", ds,
+        ctypes.byref(ds2)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds2, b"label", y2.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+    assert lib.LGBM_BoosterResetTrainingData(bst, ds2) == 0, \
+        lib.LGBM_GetLastError()
+    assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # --- SetLastError round-trip
+    lib.LGBM_SetLastError(b"custom message")
+    assert lib.LGBM_GetLastError() == b"custom message"
+
+    for h in (sub, ds2, ds):
+        lib.LGBM_DatasetFree(h)
+    lib.LGBM_BoosterFree(bst)
+
+
+def test_capi_streaming_push(lib_path):
+    """CreateByReference / CreateFromSampledColumn + PushRows(ByCSR):
+    rows stream in, FinishLoad fires on the last block, and the first
+    consumer sees a complete dataset (c_api.h:58-233)."""
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(3)
+    n, f = 300, 4
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    ref = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, b"max_bin=31",
+        None, ctypes.byref(ref)) == 0
+
+    # by-reference + dense pushes in two blocks
+    pend = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateByReference(
+        ref, ctypes.c_int64(n), ctypes.byref(pend)) == 0, \
+        lib.LGBM_GetLastError()
+    assert lib.LGBM_DatasetPushRows(
+        pend, X[:200].ctypes.data_as(ctypes.c_void_p), 1, 200, f, 0) == 0
+    # SetField is legal BEFORE the final push block (reference streaming
+    # order); it stashes and applies at FinishLoad
+    assert lib.LGBM_DatasetSetField(
+        pend, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0) == 0
+    assert lib.LGBM_DatasetPushRows(
+        pend, X[200:].ctypes.data_as(ctypes.c_void_p), 1, 100, f, 200) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        pend, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # sampled-column create + CSR push
+    from scipy.sparse import csr_matrix
+    S = csr_matrix(X)
+    cols = [np.ascontiguousarray(X[:100, j]) for j in range(f)]
+    idxs = [np.ascontiguousarray(np.arange(100, dtype=np.int32))
+            for _ in range(f)]
+    col_ptrs = (ctypes.POINTER(ctypes.c_double) * f)(*[
+        c.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for c in cols])
+    idx_ptrs = (ctypes.POINTER(ctypes.c_int) * f)(*[
+        i.ctypes.data_as(ctypes.POINTER(ctypes.c_int)) for i in idxs])
+    per_col = (ctypes.c_int * f)(*([100] * f))
+    pend2 = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromSampledColumn(
+        col_ptrs, idx_ptrs, f, per_col, 100, n, b"max_bin=31",
+        ctypes.byref(pend2)) == 0, lib.LGBM_GetLastError()
+    lib.LGBM_DatasetPushRowsByCSR.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+    assert lib.LGBM_DatasetPushRowsByCSR(
+        pend2, S.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        2, S.indices.astype(np.int32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)),
+        S.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        n + 1, S.nnz, f, 0) == 0, lib.LGBM_GetLastError()
+    nd = ctypes.c_int32(0)
+    assert lib.LGBM_DatasetGetNumData(pend2, ctypes.byref(nd)) == 0
+    assert nd.value == n
+
+    # pushing past num_total_row errors loudly
+    assert lib.LGBM_DatasetPushRows(
+        pend2, X[:10].ctypes.data_as(ctypes.c_void_p), 1, 10, f, 0) == -1
+
+    lib.LGBM_BoosterFree(bst)
+    for h in (pend2, pend, ref):
+        lib.LGBM_DatasetFree(h)
+
+
+def test_capi_csc_create(lib_path):
+    lib = ctypes.CDLL(lib_path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    from scipy.sparse import random as sprandom
+    S = sprandom(200, 6, density=0.4, random_state=1, format="csc")
+    ds = ctypes.c_void_p()
+    lib.LGBM_DatasetCreateFromCSC.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p]
+    assert lib.LGBM_DatasetCreateFromCSC(
+        S.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+        S.indices.astype(np.int32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)),
+        S.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        7, S.nnz, 200, b"max_bin=15", None, ctypes.byref(ds)) == 0, \
+        lib.LGBM_GetLastError()
+    nd = ctypes.c_int32(0)
+    nf = ctypes.c_int32(0)
+    assert lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)) == 0
+    assert lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(nf)) == 0
+    assert (nd.value, nf.value) == (200, 6)
+    lib.LGBM_DatasetFree(ds)
