@@ -266,8 +266,27 @@ class GBDT:
             _feature_meta_from_dataset(ds, cfg), self._feature_pad)
         self.num_bins = max(ds.max_col_bins(), 2)
         self.num_feat_bins = max(ds.max_num_bin(), 2)
+        # explicit feature-parallel (feature_parallel_tree_learner.cpp:
+        # 30-60): rows REPLICATED, search work divided by a bin-balanced
+        # column assignment, best splits argmax-allreduced as structs.
+        # Order-dependent extras (forced splits, CEGB) keep the GSPMD
+        # fallback, whose comm the partitioner infers.
+        self._explicit_fp = (
+            self.mesh is not None
+            and cfg.tree_learner == "feature"
+            and mesh_mod.FEATURE_AXIS in self.mesh.axis_names
+            and not cfg.forcedsplits_filename
+            and not cfg.cegb_penalty_feature_coupled
+            and not cfg.cegb_penalty_feature_lazy
+            and cfg.cegb_penalty_split <= 0)
         self.xb = jnp.asarray(xb_np)
-        if self.mesh is not None:
+        self._fp_capture = None
+        if self._explicit_fp:
+            # xb stays replicated (every FP worker holds the full data,
+            # like the reference's feature-parallel machines); each device
+            # additionally gets its own column slice for histogram work
+            self._fp_capture = self._setup_feature_parallel(xb_np)
+        elif self.mesh is not None:
             self.xb = jax.device_put(
                 self.xb, mesh_mod.feature_sharding(self.mesh))
         if self.objective is not None:
@@ -497,6 +516,66 @@ class GBDT:
                             feature=jnp.asarray(feat_arr, jnp.int32),
                             threshold=jnp.asarray(thr_arr, jnp.int32)), t
 
+    def _setup_feature_parallel(self, xb_np: np.ndarray):
+        """Bin-balanced per-device column assignment for the explicit
+        feature-parallel learner (the reference balances workers by bin
+        count, feature_parallel_tree_learner.cpp:30-60). Returns
+        (xb_cols [D, N, Cd], meta_local FeatureMeta of [D, Fd] arrays,
+        global_of_local [D, Fd]) device_put so device d holds row d.
+
+        Requires no EFB/packing (columns == features), which _setup_train
+        already enforces for meshes."""
+        from ..parallel import mesh as mesh_mod
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        d = self.mesh.shape[mesh_mod.FEATURE_AXIS]
+        n, f = xb_np.shape
+        meta = self.feature_meta
+        num_bin = np.asarray(meta.num_bin)
+        # greedy: biggest feature to the least-loaded device
+        order = np.argsort(-num_bin, kind="stable")
+        loads = np.zeros(d, np.int64)
+        assign: List[List[int]] = [[] for _ in range(d)]
+        for j in order:
+            dev = int(np.argmin(loads))
+            assign[dev].append(int(j))
+            loads[dev] += max(int(num_bin[j]), 1)
+        fd = max(max(len(a) for a in assign), 1)
+        xb_cols = np.zeros((d, n, fd), xb_np.dtype)
+        gofl = np.full((d, fd), -1, np.int32)
+        local = {"num_bin": np.ones((d, fd), np.int32),
+                 "missing_type": np.zeros((d, fd), np.int32),
+                 "default_bin": np.zeros((d, fd), np.int32),
+                 "is_categorical": np.zeros((d, fd), bool),
+                 "penalty": np.ones((d, fd), np.float32),
+                 "monotone": np.zeros((d, fd), np.int32)}
+        for dev, cols in enumerate(assign):
+            if not cols:
+                continue
+            cc = np.asarray(cols, np.int64)
+            xb_cols[dev, :, :len(cols)] = xb_np[:, cc]
+            gofl[dev, :len(cols)] = cc
+            for name in local:
+                local[name][dev, :len(cols)] = np.asarray(
+                    getattr(meta, name))[cc]
+        meta_local = FeatureMeta(
+            num_bin=jnp.asarray(local["num_bin"]),
+            missing_type=jnp.asarray(local["missing_type"]),
+            default_bin=jnp.asarray(local["default_bin"]),
+            is_categorical=jnp.asarray(local["is_categorical"]),
+            penalty=jnp.asarray(local["penalty"]),
+            monotone=jnp.asarray(local["monotone"]),
+            col=jnp.tile(jnp.arange(fd, dtype=jnp.int32)[None], (d, 1)),
+            offset=jnp.zeros((d, fd), jnp.int32),
+            bundled=jnp.zeros((d, fd), bool))
+        ax = mesh_mod.FEATURE_AXIS
+        sh1 = NamedSharding(self.mesh, P(ax))
+        # device_put straight from numpy: one sharded transfer, never a
+        # full [D, N, Fd] copy committed to a single device first
+        put = lambda a: jax.device_put(np.asarray(a), sh1)
+        return (put(xb_cols),
+                jax.tree.map(lambda a: put(a), meta_local),
+                put(gofl))
+
     def _setup_cegb(self):
         """CEGB acquisition state (device-resident, persists across trees —
         SerialTreeLearner feature_used / feature_used_in_data,
@@ -558,15 +637,31 @@ class GBDT:
         return mask
 
     def _make_train_iter_fn(self) -> Callable:
-        """Build the jitted per-iteration function."""
+        """Build the jitted per-iteration function.
+
+        Mesh-sharded constants (the binned matrix, the objective's per-row
+        arrays) are ARGUMENTS, not closure captures: a multi-controller jit
+        may not close over arrays that span non-addressable devices, and
+        the single-process path costs nothing by sharing the convention.
+        ``self._iter_capture`` holds the tuple to pass each call.
+        """
         meta = self.feature_meta
         params = self.grow_params
-        xb = self.xb
         mesh = self.mesh
         obj = self.objective
         k = self.num_tree_per_iteration
         n = self.num_data
         use_input = self._use_input_grads or obj is None
+        # per-row device arrays living on the objective (label, weights,
+        # trans_label, onehot, ...) — anything get_gradients might read
+        obj_row_names = tuple(sorted(
+            nm for nm, v in (obj.__dict__.items() if obj is not None else ())
+            if isinstance(v, jnp.ndarray) and v.ndim >= 1
+            and v.shape[0] in (n, self.num_data_orig)))
+        self._iter_capture = (
+            self.xb, tuple(getattr(obj, nm) for nm in obj_row_names),
+            self._fp_capture)
+        import copy as _copy
         is_goss = self.boosting_type == "goss"
         if is_goss:
             # counts from the REAL row count, not the mesh-padding-inflated
@@ -579,17 +674,22 @@ class GBDT:
 
         forced_splits = self._forced_splits
 
-        def run_iter(scores, sample_mask, feature_mask,
-                     grad_in, hess_in, lr, goss_active, goss_key,
-                     cegb_state, stopped_in):
+        def run_iter(xb, obj_rows, fp_capture, scores, sample_mask,
+                     feature_mask, grad_in, hess_in, lr, goss_active,
+                     goss_key, cegb_state, stopped_in):
             # gradients: objective or custom (grad_in) (gbdt.cpp:333-347)
             if not use_input:
+                # bind the argument arrays onto a shallow copy — the traced
+                # values, not the captured originals, feed get_gradients
+                o = _copy.copy(obj)
+                for nm, v in zip(obj_row_names, obj_rows):
+                    setattr(o, nm, v)
                 if k == 1:
-                    g, h = obj.get_gradients(scores[:, 0])
+                    g, h = o.get_gradients(scores[:, 0])
                     g = g[:, None]
                     h = h[:, None]
                 else:
-                    g, h = obj.get_gradients(scores)
+                    g, h = o.get_gradients(scores)
             else:
                 g, h = grad_in, hess_in
 
@@ -615,7 +715,37 @@ class GBDT:
                 h = h * mult[:, None]
                 sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
 
-            if params.partition_on_mesh or params.voting_top_k > 0:
+            if fp_capture is not None:
+                # explicit feature-parallel: one shard_map over the feature
+                # axis; rows replicated, column slices + local metas device-
+                # varying, best splits struct-allreduced inside grow_tree
+                from jax.sharding import PartitionSpec as P
+                from ..parallel.mesh import FEATURE_AXIS
+                from ..core.grow import FeatureParallelCtx
+                tree_spec = jax.tree.map(lambda _: P(),
+                                         empty_tree(params.num_leaves))
+                xb_cols, meta_loc, gofl = fp_capture
+                ml_specs = jax.tree.map(lambda _: P(FEATURE_AXIS), meta_loc)
+
+                def _fp_core(xbg, xbl, ml, go, gj, hj, mj, fm):
+                    ctx = FeatureParallelCtx(
+                        xb_local=xbl[0],
+                        meta_local=jax.tree.map(lambda a: a[0], ml),
+                        global_of_local=go[0])
+                    return grow_tree(xbg, gj, hj, mj, meta, fm, params,
+                                     axis_name=FEATURE_AXIS, fp=ctx)[:2]
+
+                grow_fp = jax.shard_map(
+                    _fp_core, mesh=mesh,
+                    in_specs=(P(), P(FEATURE_AXIS), ml_specs,
+                              P(FEATURE_AXIS), P(), P(), P(), P()),
+                    out_specs=(tree_spec, P()), check_vma=False)
+
+                def grow_one(gk, hk, cs):
+                    t, li = grow_fp(xb, xb_cols, meta_loc, gofl, gk, hk,
+                                    sample_mask, feature_mask)
+                    return t, li, None
+            elif params.partition_on_mesh or params.voting_top_k > 0:
                 # explicit shard_map learners (mutually exclusive configs):
                 # - data-parallel partition: local fused partition+hist per
                 #   device, psum only on the [F, B, 6] child histograms;
@@ -734,8 +864,9 @@ class GBDT:
         row_valid = self._row_valid
 
         @jax.jit
-        def run_block(scores, feature_masks, goss_actives, iter_idxs, keys,
-                      bag_mask0, cegb_state, stopped_in, lr):
+        def run_block(xb, obj_rows, fp_capture, scores, feature_masks,
+                      goss_actives, iter_idxs, keys, bag_mask0, cegb_state,
+                      stopped_in, lr):
             g0 = jnp.zeros((n, k), jnp.float32)
             h0 = jnp.ones((n, k), jnp.float32)
 
@@ -751,7 +882,8 @@ class GBDT:
                     bag_mask = jnp.where(refresh, new_mask, bag_mask)
                 sm = bag_mask if row_valid is None else bag_mask * row_valid
                 packed, _leaf_ids, sc2, cegb2, stopped2 = core(
-                    sc, sm, fm, g0, h0, lr, ga, gkey, cegb, stopped)
+                    xb, obj_rows, fp_capture, sc, sm, fm, g0, h0, lr, ga,
+                    gkey, cegb, stopped)
                 return (sc2, bag_mask, cegb2, stopped2), packed
 
             carry, packs = lax.scan(
@@ -799,6 +931,7 @@ class GBDT:
             self._bag_key = all_keys[0]
             packs, self.scores, self._bag_mask, self._cegb_state, \
                 self._stopped_dev = fn(
+                    *self._iter_capture,
                     self.scores, fmasks, gactive, idxs, all_keys[1:],
                     self._bag_mask, self._cegb_state, self._stopped_dev,
                     jnp.float32(self.shrinkage_rate))
@@ -871,6 +1004,7 @@ class GBDT:
         prev_scores = self.scores
         packed, leaf_ids, new_scores, cegb_new, self._stopped_dev = \
             self._compiled_iter(
+                *self._iter_capture,
                 self.scores, sample_mask, feature_mask, g_in, h_in,
                 jnp.float32(self.shrinkage_rate),
                 jnp.float32(self._goss_active(iter_idx)), goss_key,
@@ -980,12 +1114,14 @@ class GBDT:
         ``prev_scores`` are the scores BEFORE this iteration's tree."""
         alpha = self.objective.renew_percentile()
         n0 = self.num_data_orig
-        label = np.asarray(self.objective.label)[:n0]
-        w = (np.asarray(self.objective.weights)[:n0]
-             if self.objective.weights is not None else np.ones_like(label))
+        # host() = pre-pad, pre-shard copies — never np.asarray a possibly
+        # mesh-sharded array (not addressable from one process)
+        label = self.objective.host("label")[:n0]
+        w_host = self.objective.host("weights")
+        w = (w_host[:n0] if w_host is not None else np.ones_like(label))
         if hasattr(self.objective, "label_weight") and \
                 self.objective.name == "mape":
-            w = np.asarray(self.objective.label_weight)[:n0]
+            w = self.objective.host("label_weight")[:n0]
         scores_np = np.array(prev_scores)
         leaf_ids_np = np.asarray(leaf_ids)
         mask = np.asarray(sample_mask)[:n0] > 0

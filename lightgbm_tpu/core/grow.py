@@ -331,6 +331,46 @@ def _bin_go_left(col: jnp.ndarray, threshold: jnp.ndarray,
     return jnp.where(is_cat, categorical, numerical)
 
 
+class FeatureParallelCtx(NamedTuple):
+    """Device-varying context for the EXPLICIT feature-parallel learner
+    (feature_parallel_tree_learner.cpp:30-60): every device holds the full
+    rows, histogram/search work is divided by a bin-balanced column
+    assignment, and only best-split STRUCTS cross the mesh.
+
+    xb_local: [N, Cd] this device's stored-column slice (hist build input);
+    meta_local: FeatureMeta over the device's features, with ``col``
+    pointing into xb_local; global_of_local: [Fd] int32 map back to global
+    feature indices (-1 padding carries feature_mask False).
+    """
+    xb_local: jnp.ndarray
+    meta_local: FeatureMeta
+    global_of_local: jnp.ndarray
+
+
+def sync_best_split(bs: BestSplit, axis_name: str) -> BestSplit:
+    """SyncUpGlobalBestSplit (parallel_tree_learner.h:186-230) as one
+    argmax-allreduce: every rank contributes its local best-split struct,
+    the max-gain rank's struct is broadcast to all. Comm volume is
+    O(struct fields), never O(F*B)."""
+    gains = lax.all_gather(bs.gain, axis_name)          # [D]
+    winner = jnp.argmax(gains).astype(jnp.int32)
+    mine = lax.axis_index(axis_name) == winner
+
+    def bcast(v):
+        if v.dtype == jnp.bool_:
+            z = jnp.where(mine, v.astype(jnp.int32), 0)
+            return lax.psum(z, axis_name) > 0
+        if v.dtype == jnp.uint32:
+            # lossless: bitcast to i32 (sum of winner's word + zeros is
+            # exact), never a value-cast that truncates the high bit
+            z = jnp.where(mine, lax.bitcast_convert_type(v, jnp.int32), 0)
+            return lax.bitcast_convert_type(lax.psum(z, axis_name),
+                                            jnp.uint32)
+        return lax.psum(jnp.where(mine, v, jnp.zeros_like(v)), axis_name)
+
+    return jax.tree.map(bcast, bs)
+
+
 def propagate_monotone_bounds(mono, left_output, right_output, p_min, p_max):
     """Monotone constraint propagation (serial_tree_learner.cpp:790-847):
     children inherit the parent's output bounds; a monotone split feature
@@ -351,6 +391,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               axis_name: Optional[str] = None,
               forced: Optional[ForcedSplits] = None,
               cegb: Optional[CegbState] = None,
+              fp: Optional[FeatureParallelCtx] = None,
               ) -> Tuple[TreeArrays, jnp.ndarray, Optional[CegbState]]:
     """Grow one leaf-wise tree; returns (tree, final per-row leaf_id,
     updated CEGB state or None).
@@ -359,6 +400,14 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sample_mask [N] f32 bagging inclusion. With ``axis_name`` set, rows are
     assumed sharded over that mesh axis and histograms/root sums are
     psum-reduced (the data-parallel learner's ReduceScatter analog).
+
+    With ``fp`` set (explicit feature-parallel,
+    feature_parallel_tree_learner.cpp:30-60): rows are REPLICATED, each
+    device builds histograms and searches splits only over its assigned
+    columns (fp.xb_local / fp.meta_local), and the per-leaf best split is
+    argmax-allreduced as a struct (sync_best_split) — row partitioning is
+    then computed locally and identically on every device from the
+    replicated xb.
     """
     n, ncols = xb.shape                 # stored columns (== F without EFB)
     f = meta.num_bin.shape[0]           # logical features
@@ -367,12 +416,32 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     bf = params.num_feat_bins or b      # per-feature bin axis (split search)
     sp = params.split
 
-    voting = params.voting_top_k > 0 and axis_name is not None
-    use_partition = params.use_partition and (
+    fp_mode = fp is not None and axis_name is not None
+    # self-enforcing invariant (not just the GBDT gate): fp mode has no
+    # expand/global-histogram machinery for forced splits, CEGB penalties,
+    # or voting — silently dropping them would build wrong trees
+    assert not fp_mode or (forced is None and cegb is None
+                           and params.num_forced == 0
+                           and params.voting_top_k == 0), \
+        "feature-parallel fp mode is incompatible with forced splits / " \
+        "CEGB / voting (route through the GSPMD fallback instead)"
+    voting = params.voting_top_k > 0 and axis_name is not None and not fp_mode
+    use_partition = params.use_partition and not fp_mode and (
         axis_name is None or (params.partition_on_mesh and not voting))
+    # histogram source: the device's column slice in fp mode
+    xb_hist = fp.xb_local if fp_mode else xb
+    ncols_h = xb_hist.shape[1]
+    if fp_mode:
+        gofl = fp.global_of_local
+        fmask_local = jnp.where(
+            gofl >= 0, feature_mask[jnp.maximum(gofl, 0)], False)
 
     def psum(x):
-        return lax.psum(x, axis_name) if axis_name is not None else x
+        # fp mode: histograms are per-device partial WORK, not partial
+        # sums — nothing to reduce (rows are replicated)
+        if fp_mode or axis_name is None:
+            return x
+        return lax.psum(x, axis_name)
 
     # CEGB's lazy acquisition accounting reads leaf_id during growth; only
     # then is the per-split leaf_id scatter worth its cost — otherwise the
@@ -380,7 +449,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     maintain_lid = (cegb is not None and params.with_cegb_lazy)
 
     def hist_for_mask(mask_f32):
-        h = build_histogram(xb, grad, hess, mask_f32, num_bins=b,
+        h = build_histogram(xb_hist, grad, hess, mask_f32, num_bins=b,
                             row_chunk=params.row_chunk, impl=params.hist_impl)
         # voting mode keeps histograms LOCAL (the pool then supports local
         # subtraction); only elected candidates are reduced, in voting_best
@@ -410,6 +479,19 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     def full_best(hist, sum_g, sum_h, cnt, depth_ok, min_c=-jnp.inf,
                   max_c=jnp.inf, gain_penalty=None):
+        if fp_mode:
+            # local search over this device's columns, then one struct
+            # allreduce (SyncUpGlobalBestSplit) — comm O(fields), not O(F*B)
+            assert gain_penalty is None, \
+                "CEGB gain penalties cannot ride the fp-mode local search"
+            bs = find_best_split(hist, fp.meta_local, sp, sum_g, sum_h, cnt,
+                                 fmask_local, min_constraint=min_c,
+                                 max_constraint=max_c,
+                                 with_categorical=params.with_categorical)
+            bs = bs._replace(
+                feature=jnp.maximum(gofl[bs.feature], 0),
+                gain=jnp.where(depth_ok, bs.gain, K_MIN_SCORE))
+            return sync_best_split(bs, axis_name)
         bs = find_best_split(expand(hist, sum_g, sum_h, cnt), meta, sp,
                              sum_g, sum_h, cnt,
                              feature_mask, min_constraint=min_c,
@@ -489,7 +571,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # children directly, so there is no parent to subtract from, and forced
     # splits rebuild any leaf's histogram from its rows
     num_slots = 1 if use_partition else (params.pool_slots if capped else l)
-    hist_pool = jnp.zeros((num_slots, ncols, b, 3), jnp.float32)
+    hist_pool = jnp.zeros((num_slots, ncols_h, b, 3), jnp.float32)
     if voting:
         # the pool holds LOCAL histograms in voting mode -> device-varying
         hist_pool = lax.pcast(hist_pool, (axis_name,), to="varying")
@@ -515,7 +597,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 lambda _: hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
                                         params.row_chunk, valid=True,
                                         impl=params.hist_impl),
-                lambda _: jnp.zeros((ncols, b, 3), jnp.float32),
+                lambda _: jnp.zeros((ncols_h, b, 3), jnp.float32),
                 operand=None)
         if not capped:
             return s.hist_pool[leaf_idx]
@@ -745,7 +827,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
             # skip dead iterations entirely (tree stopped growing early)
             hist_small = lax.cond(valid, live_hist,
-                                  lambda _: jnp.zeros((ncols, b, 3),
+                                  lambda _: jnp.zeros((ncols_h, b, 3),
                                                       jnp.float32),
                                   operand=None)
         else:
@@ -879,10 +961,11 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             dead = jax.tree.map(lambda a: a[0], _empty_best(1))
             return dead, dead
 
-        if voting:
-            # voting_best holds collectives (all_gather/psum) — it cannot sit
-            # under a cond branch; dead iterations just elect over zeros and
-            # are discarded by the masked best-update below
+        if voting or fp_mode:
+            # voting_best / sync_best_split hold collectives (all_gather /
+            # psum) — they cannot sit under a cond branch; dead iterations
+            # just reduce over zeros and are discarded by the masked
+            # best-update below
             bl, br = child_bests(None)
         else:
             bl, br = lax.cond(valid, child_bests, dead_bests, operand=None)

@@ -60,21 +60,38 @@ class ObjectiveFunction:
         out of every histogram/sum by the driver's row_valid mask; gradients
         computed on them are never used). Every jnp attribute of length
         num_data is treated as per-row (label, weights, trans_label,
-        label_weight, ...)."""
+        label_weight, ...).
+
+        Pre-pad host copies are kept (``host()``): host-side statistics
+        like boost_from_score must see neither the padding rows (they'd
+        bias means/percentiles) nor a multi-process-sharded array (not
+        addressable from one host)."""
         n0 = self.label.shape[0]
         pad = num_rows - n0
         sh = None
         if mesh is not None:
             from .parallel.mesh import row_sharding
             sh = row_sharding(mesh)
+        self._host_rows = {}
         for name, val in list(self.__dict__.items()):
             if isinstance(val, jnp.ndarray) and val.ndim == 1 \
                     and val.shape[0] == n0:
+                self._host_rows[name] = np.asarray(val)
                 if pad > 0:
                     val = jnp.concatenate([val, jnp.zeros((pad,), val.dtype)])
                 if sh is not None:
                     val = jax.device_put(val, sh)
                 setattr(self, name, val)
+
+    def host(self, name: str):
+        """Host numpy view of a per-row attribute — the pre-pad, pre-shard
+        copy when pad_to ran (multi-host safe, padding excluded); None when
+        the attribute is None."""
+        cache = getattr(self, "_host_rows", None)
+        if cache is not None and name in cache:
+            return cache[name]
+        val = getattr(self, name)
+        return None if val is None else np.asarray(val)
 
     def get_gradients(self, score: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         raise NotImplementedError
@@ -90,7 +107,7 @@ class ObjectiveFunction:
     renew_tree_output = None
 
     def _wmean(self, values: np.ndarray) -> float:
-        w = None if self.weights is None else np.asarray(self.weights)
+        w = self.host("weights")
         return float(np.average(np.asarray(values), weights=w))
 
 
@@ -116,7 +133,7 @@ class RegressionL2Loss(ObjectiveFunction):
         return self._apply_weights(grad, hess)
 
     def boost_from_score(self, class_id=0):
-        return self._wmean(np.asarray(self.trans_label))
+        return self._wmean(self.host("trans_label"))
 
     def convert_output(self, score):
         if self.config.reg_sqrt:
@@ -136,9 +153,9 @@ class RegressionL1Loss(RegressionL2Loss):
         return self._apply_weights(grad, hess)
 
     def boost_from_score(self, class_id=0):
-        lab = np.asarray(self.trans_label)
+        lab = self.host("trans_label")
         if self.weights is not None:
-            return _weighted_percentile(lab, np.asarray(self.weights), 0.5)
+            return _weighted_percentile(lab, self.host("weights"), 0.5)
         return float(np.percentile(lab, 50, method="lower")) if len(lab) else 0.0
 
     def renew_percentile(self) -> float:
@@ -180,7 +197,7 @@ class RegressionPoissonLoss(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        if float(np.min(np.asarray(self.label))) < 0:
+        if float(np.min(self.host("label"))) < 0:
             raise LightGBMError("[poisson]: at least one target label is negative")
 
     def get_gradients(self, score):
@@ -189,7 +206,7 @@ class RegressionPoissonLoss(ObjectiveFunction):
         return self._apply_weights(grad, hess)
 
     def boost_from_score(self, class_id=0):
-        return math.log(max(self._wmean(np.asarray(self.label)), 1e-20))
+        return math.log(max(self._wmean(self.host("label")), 1e-20))
 
     def convert_output(self, score):
         return jnp.exp(score)
@@ -207,9 +224,9 @@ class RegressionQuantileLoss(RegressionL2Loss):
         return self._apply_weights(grad, hess)
 
     def boost_from_score(self, class_id=0):
-        lab = np.asarray(self.trans_label)
+        lab = self.host("trans_label")
         if self.weights is not None:
-            return _weighted_percentile(lab, np.asarray(self.weights),
+            return _weighted_percentile(lab, self.host("weights"),
                                         self.config.alpha)
         return float(np.percentile(lab, self.config.alpha * 100, method="lower"))
 
@@ -235,8 +252,8 @@ class RegressionMAPELoss(ObjectiveFunction):
         return grad, hess
 
     def boost_from_score(self, class_id=0):
-        lab = np.asarray(self.label)
-        return _weighted_percentile(lab, np.asarray(self.label_weight), 0.5)
+        lab = self.host("label")
+        return _weighted_percentile(lab, self.host("label_weight"), 0.5)
 
     def renew_percentile(self) -> float:
         return 0.5
@@ -307,8 +324,8 @@ class BinaryLogloss(ObjectiveFunction):
         return self._apply_weights(grad, hess)
 
     def boost_from_score(self, class_id=0):
-        lab = np.asarray(self.label01)
-        w = np.asarray(self.weights) if self.weights is not None else None
+        lab = self.host("label01")
+        w = self.host("weights")
         pavg = float(np.average(lab, weights=w))
         pavg = min(max(pavg, 1e-15), 1 - 1e-15)
         init = math.log(pavg / (1 - pavg)) / self.config.sigmoid
@@ -419,7 +436,7 @@ class CrossEntropy(ObjectiveFunction):
                 p * (1.0 - p) * self.weights)
 
     def boost_from_score(self, class_id=0):
-        pavg = min(max(self._wmean(np.asarray(self.label)), 1e-15), 1 - 1e-15)
+        pavg = min(max(self._wmean(self.host("label")), 1e-15), 1 - 1e-15)
         return math.log(pavg / (1 - pavg))
 
     def convert_output(self, score):
@@ -449,7 +466,7 @@ class CrossEntropyLambda(CrossEntropy):
         return grad, hess
 
     def boost_from_score(self, class_id=0):
-        pavg = min(max(self._wmean(np.asarray(self.label)), 1e-15), 1 - 1e-15)
+        pavg = min(max(self._wmean(self.host("label")), 1e-15), 1 - 1e-15)
         return math.log(math.expm1(pavg)) if pavg > 0 else -50.0
 
     def convert_output(self, score):

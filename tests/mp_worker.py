@@ -1,0 +1,56 @@
+"""Worker process for the real multi-process test (one of N ranks).
+
+Run by tests/test_multiprocess.py: each rank is a separate OS process
+with ONE local CPU device; jax.distributed glues them into a 2-device
+global mesh and the data-parallel learner trains across it — the live
+analog of the reference's socket-machine walkthrough
+(docs/Parallel-Learning-Guide.rst:38-110).
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    rank = int(os.environ["LIGHTGBM_TPU_RANK"])
+    port = os.environ["MP_TEST_PORT"]
+    out_path = os.environ["MP_TEST_OUT"]
+
+    from lightgbm_tpu.parallel import network
+    # rank 0's entry doubles as the jax.distributed coordinator address
+    network.init(machines="127.0.0.1:%s,127.0.0.1:0" % port,
+                 num_machines=2, time_out=60)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+
+    r = np.random.RandomState(0)
+    X = r.randn(4096, 8).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+
+    cfg = Config({"objective": "binary", "tree_learner": "data",
+                  "num_machines": 2, "num_leaves": 15, "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    for _ in range(5):
+        b.train_one_iter()
+    pred = np.asarray(b.predict(X[:256], raw_score=True), np.float64)
+
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"pred": pred.tolist()}, f)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

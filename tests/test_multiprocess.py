@@ -1,0 +1,73 @@
+"""A REAL multi-process run: two OS processes, one CPU device each,
+glued by jax.distributed through parallel/network.py — the executable
+form of the reference's parallel-learning walkthrough
+(docs/Parallel-Learning-Guide.rst:38-110). Asserts the 2-process
+data-parallel model matches single-process training.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_data_parallel_matches_single(tmp_path):
+    port = _free_port()
+    out = str(tmp_path / "rank0.json")
+    env_base = {**os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "",            # exactly one device per process
+                "MP_TEST_PORT": str(port),
+                "MP_TEST_OUT": out,
+                "PYTHONPATH": REPO}
+    procs = []
+    for rank in range(2):
+        env = dict(env_base, LIGHTGBM_TPU_RANK=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "mp_worker.py")],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            so, se = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process worker timed out")
+        outs.append((p.returncode, so, se))
+    for rc, so, se in outs:
+        assert rc == 0, (so[-500:], se[-2000:])
+    with open(out) as f:
+        pred_mp = np.asarray(json.load(f)["pred"])
+
+    # single-process reference on the identical data/config (serial)
+    import jax
+    r = np.random.RandomState(0)
+    X = r.randn(4096, 8).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+    cfg = Config({"objective": "binary", "num_leaves": 15,
+                  "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    for _ in range(5):
+        b.train_one_iter()
+    pred_sp = np.asarray(b.predict(X[:256], raw_score=True), np.float64)
+    np.testing.assert_allclose(pred_mp, pred_sp, rtol=2e-4, atol=2e-4)

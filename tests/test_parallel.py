@@ -228,3 +228,74 @@ def test_goss_under_mesh_uses_real_counts():
     # GOSS sampling is stochastic; equal-count semantics keep AUC in step
     assert auc_m > 0.9
     assert abs(auc_m - auc_s) < 0.05
+
+
+def test_explicit_feature_parallel_engaged_and_matches():
+    """The EXPLICIT feature-parallel learner (bin-balanced column
+    assignment + argmax-allreduce of split structs, grow.sync_best_split —
+    feature_parallel_tree_learner.cpp:30-60) is the default for
+    tree_learner=feature and reproduces serial predictions; forced splits
+    fall back to the GSPMD learner."""
+    import json
+    import os
+    import tempfile
+    X, y = make_binary(n=1500)
+    serial = _train({"objective": "binary", "verbosity": -1}, X, y,
+                    rounds=4)
+    fp = _train({"objective": "binary", "tree_learner": "feature",
+                 "verbosity": -1}, X, y, rounds=4)
+    assert fp._explicit_fp and fp._fp_capture is not None
+    ps = serial.predict(X[:300], raw_score=True)
+    pf = fp.predict(X[:300], raw_score=True)
+    np.testing.assert_allclose(ps, pf, rtol=2e-4, atol=2e-4)
+
+    fs = {"feature": 0, "threshold": float(np.median(X[:, 0]))}
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(fs, f)
+        path = f.name
+    try:
+        fp2 = _train({"objective": "binary", "tree_learner": "feature",
+                      "forcedsplits_filename": path, "verbosity": -1},
+                     X, y, rounds=2)
+        assert not fp2._explicit_fp
+    finally:
+        os.unlink(path)
+
+
+def test_sync_best_split_broadcasts_winner():
+    """sync_best_split = SyncUpGlobalBestSplit: every rank ends up with
+    the max-gain rank's full struct, including bool/uint32 fields."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lightgbm_tpu.core.grow import sync_best_split
+    from lightgbm_tpu.core.split import BestSplit
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, ("f",))
+    d = len(devs)
+
+    def make(rank):
+        r = rank.astype(jnp.float32)
+        return BestSplit(
+            gain=jnp.where(rank == 2, 9.0, r),   # rank 2 wins
+            feature=rank * 10, threshold=rank + 1,
+            default_left=(rank % 2) == 0,
+            left_sum_grad=r, left_sum_hess=r, left_count=r,
+            right_sum_grad=r, right_sum_hess=r, right_count=r,
+            left_output=r, right_output=r,
+            is_categorical=rank == 2,
+            cat_bitset=jnp.full((8,), rank.astype(jnp.uint32) + 7,
+                                jnp.uint32))
+
+    out = jax.jit(jax.shard_map(
+        lambda _: jax.tree.map(
+            lambda a: a[None],
+            sync_best_split(make(jax.lax.axis_index("f")), "f")),
+        mesh=mesh, in_specs=(P("f"),), out_specs=P("f"),
+        check_vma=False))(jnp.zeros((d,)))
+    # every rank holds rank 2's struct
+    assert np.all(np.asarray(out.gain) == 9.0)
+    assert np.all(np.asarray(out.feature) == 20)
+    assert np.all(np.asarray(out.threshold) == 3)
+    assert np.all(np.asarray(out.is_categorical))
+    assert np.all(np.asarray(out.cat_bitset) == 9)
