@@ -158,8 +158,14 @@ def run_bench(backend_info: dict) -> dict:
     v5e_peak_flops = 197e12
     flops_per_visit = 3 * 256 * 2 * 2.0
     depth_avg = max(1.0, np.ceil(np.log2(max(num_leaves, 2))))
-    mfu = (iters_per_sec * n * f * depth_avg * flops_per_visit
-           / v5e_peak_flops)
+    # only meaningful for an honest TPU run: zeroed with the throughput
+    # fields when the AUC guard fires, and not emitted against the v5e
+    # roofline for a CPU-fallback run
+    if train_auc_ok and not backend_info.get("fallback"):
+        mfu = (iters_per_sec * n * f * depth_avg * flops_per_visit
+               / v5e_peak_flops)
+    else:
+        mfu = 0.0
     return {
         "metric": "boosting_iters_per_sec_higgs_equivalent "
                   "(binary GBDT, %dk rows x %d feat, %d leaves, 255 bins)"

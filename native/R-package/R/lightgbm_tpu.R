@@ -1,0 +1,77 @@
+# R front end over the lightgbm_tpu C ABI (.Call glue in
+# src/lightgbm_tpu_R.cpp). Mirrors the reference R package's surface at
+# minimal scale: Dataset construction, training, prediction, model IO.
+# The heavy runtime (JAX/XLA on TPU) lives behind lib_lightgbm.so.
+
+lgbt.Dataset <- function(data, label = NULL, params = "") {
+  stopifnot(is.matrix(data))
+  storage.mode(data) <- "double"
+  handle <- .Call(LGBMTPU_DatasetCreateFromMat_R, data,
+                  nrow(data), ncol(data), as.character(params))
+  ds <- list(handle = handle)
+  class(ds) <- "lgbt.Dataset"
+  if (!is.null(label)) {
+    lgbt.Dataset.set.field(ds, "label", label)
+  }
+  ds
+}
+
+lgbt.Dataset.set.field <- function(dataset, name, values) {
+  stopifnot(inherits(dataset, "lgbt.Dataset"))
+  if (name %in% c("group", "query")) {
+    values <- as.integer(values)
+  } else {
+    values <- as.double(values)
+  }
+  .Call(LGBMTPU_DatasetSetField_R, dataset$handle, as.character(name),
+        values)
+  invisible(dataset)
+}
+
+lgbt.train <- function(params, data, nrounds = 100) {
+  stopifnot(inherits(data, "lgbt.Dataset"))
+  handle <- .Call(LGBMTPU_BoosterCreate_R, data$handle,
+                  as.character(params))
+  bst <- list(handle = handle)
+  class(bst) <- "lgbt.Booster"
+  for (i in seq_len(nrounds)) {
+    finished <- .Call(LGBMTPU_BoosterUpdateOneIter_R, handle)
+    if (finished != 0L) break
+  }
+  bst
+}
+
+lgbt.predict <- function(booster, data, type = c("normal", "raw"),
+                         num_iteration = -1L) {
+  stopifnot(inherits(booster, "lgbt.Booster"), is.matrix(data))
+  storage.mode(data) <- "double"
+  type <- match.arg(type)
+  predict_type <- if (type == "raw") 1L else 0L
+  .Call(LGBMTPU_BoosterPredictForMat_R, booster$handle, data,
+        nrow(data), ncol(data), predict_type, as.integer(num_iteration))
+}
+
+lgbt.save <- function(booster, filename) {
+  stopifnot(inherits(booster, "lgbt.Booster"))
+  .Call(LGBMTPU_BoosterSaveModel_R, booster$handle,
+        as.character(filename))
+  invisible(booster)
+}
+
+lgbt.model.string <- function(booster) {
+  stopifnot(inherits(booster, "lgbt.Booster"))
+  .Call(LGBMTPU_BoosterSaveModelToString_R, booster$handle)
+}
+
+lgbt.load <- function(filename) {
+  handle <- .Call(LGBMTPU_BoosterCreateFromModelfile_R,
+                  as.character(filename))
+  bst <- list(handle = handle)
+  class(bst) <- "lgbt.Booster"
+  bst
+}
+
+lgbt.num.trees <- function(booster) {
+  stopifnot(inherits(booster, "lgbt.Booster"))
+  .Call(LGBMTPU_BoosterNumberOfTotalModel_R, booster$handle)
+}

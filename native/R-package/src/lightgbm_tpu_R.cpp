@@ -1,0 +1,181 @@
+/* .Call glue: R <-> lightgbm_tpu C ABI.
+ *
+ * Strategy mirrors the reference R package (src/lightgbm_R.cpp wraps the
+ * LGBM_* C surface in SEXP shims); the code here is an original, smaller
+ * design: handles ride R external pointers with finalizers, numeric
+ * matrices cross as REALSXP column-major buffers (is_row_major = 0), and
+ * every ABI failure raises an R error carrying LGBM_GetLastError().
+ *
+ * Built by R CMD INSTALL via src/Makevars against lib_lightgbm.so.
+ * This image carries no R toolchain; tests/test_r_binding.py
+ * syntax-checks this translation unit against a minimal mock of the R
+ * API (tests/r_mock/) so the glue cannot rot silently.
+ */
+#include <cstdint>
+#include <cstring>
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include "../../include/lightgbm_tpu_c_api.h"
+
+namespace {
+
+void check_call(int rc) {
+  if (rc != 0) {
+    Rf_error("lightgbm_tpu: %s", LGBM_GetLastError());
+  }
+}
+
+void dataset_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+void booster_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP wrap_handle(void* h, R_CFinalizer_t fin) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+void* unwrap(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h == nullptr) {
+    Rf_error("lightgbm_tpu: handle already freed");
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+/* data: numeric matrix (column-major), params: string */
+SEXP LGBMTPU_DatasetCreateFromMat_R(SEXP data, SEXP nrow, SEXP ncol,
+                                    SEXP params) {
+  void* out = nullptr;
+  check_call(LGBM_DatasetCreateFromMat(
+      REAL(data), C_API_DTYPE_FLOAT64, Rf_asInteger(nrow),
+      Rf_asInteger(ncol), /*is_row_major=*/0,
+      CHAR(Rf_asChar(params)), nullptr, &out));
+  return wrap_handle(out, dataset_finalizer);
+}
+
+SEXP LGBMTPU_DatasetSetField_R(SEXP handle, SEXP name, SEXP values) {
+  const char* field = CHAR(Rf_asChar(name));
+  int n = Rf_length(values);
+  if (std::strcmp(field, "group") == 0 ||
+      std::strcmp(field, "query") == 0) {
+    check_call(LGBM_DatasetSetField(unwrap(handle), field,
+                                    INTEGER(values), n,
+                                    C_API_DTYPE_INT32));
+  } else {
+    /* label/weight are float32 on the ABI */
+    float* buf = (float*)R_alloc(n, sizeof(float));
+    double* src = REAL(values);
+    for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+    check_call(LGBM_DatasetSetField(unwrap(handle), field, buf, n,
+                                    C_API_DTYPE_FLOAT32));
+  }
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterCreate_R(SEXP train, SEXP params) {
+  void* out = nullptr;
+  check_call(LGBM_BoosterCreate(unwrap(train), CHAR(Rf_asChar(params)),
+                                &out));
+  return wrap_handle(out, booster_finalizer);
+}
+
+SEXP LGBMTPU_BoosterUpdateOneIter_R(SEXP handle) {
+  int finished = 0;
+  check_call(LGBM_BoosterUpdateOneIter(unwrap(handle), &finished));
+  return Rf_ScalarInteger(finished);
+}
+
+SEXP LGBMTPU_BoosterPredictForMat_R(SEXP handle, SEXP data, SEXP nrow,
+                                    SEXP ncol, SEXP predict_type,
+                                    SEXP num_iteration) {
+  int nr = Rf_asInteger(nrow);
+  int64_t out_len = 0;
+  check_call(LGBM_BoosterCalcNumPredict(unwrap(handle), nr,
+                                        Rf_asInteger(predict_type),
+                                        Rf_asInteger(num_iteration),
+                                        &out_len));
+  SEXP result = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)out_len));
+  int64_t written = 0;
+  check_call(LGBM_BoosterPredictForMat(
+      unwrap(handle), REAL(data), C_API_DTYPE_FLOAT64, nr,
+      Rf_asInteger(ncol), /*is_row_major=*/0,
+      Rf_asInteger(predict_type), Rf_asInteger(num_iteration), "",
+      &written, REAL(result)));
+  UNPROTECT(1);
+  return result;
+}
+
+SEXP LGBMTPU_BoosterSaveModel_R(SEXP handle, SEXP filename) {
+  check_call(LGBM_BoosterSaveModel(unwrap(handle), 0, -1,
+                                   CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP handle) {
+  int64_t out_len = 0;
+  check_call(LGBM_BoosterSaveModelToString(unwrap(handle), 0, -1, 0,
+                                           &out_len, nullptr));
+  char* buf = (char*)R_alloc((size_t)out_len, 1);
+  check_call(LGBM_BoosterSaveModelToString(unwrap(handle), 0, -1, out_len,
+                                           &out_len, buf));
+  return Rf_mkString(buf);
+}
+
+SEXP LGBMTPU_BoosterCreateFromModelfile_R(SEXP filename) {
+  void* out = nullptr;
+  int iters = 0;
+  check_call(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)),
+                                             &iters, &out));
+  return wrap_handle(out, booster_finalizer);
+}
+
+SEXP LGBMTPU_BoosterNumberOfTotalModel_R(SEXP handle) {
+  int out = 0;
+  check_call(LGBM_BoosterNumberOfTotalModel(unwrap(handle), &out));
+  return Rf_ScalarInteger(out);
+}
+
+static const R_CallMethodDef kCallMethods[] = {
+    {"LGBMTPU_DatasetCreateFromMat_R",
+     (DL_FUNC)&LGBMTPU_DatasetCreateFromMat_R, 4},
+    {"LGBMTPU_DatasetSetField_R", (DL_FUNC)&LGBMTPU_DatasetSetField_R, 3},
+    {"LGBMTPU_BoosterCreate_R", (DL_FUNC)&LGBMTPU_BoosterCreate_R, 2},
+    {"LGBMTPU_BoosterUpdateOneIter_R",
+     (DL_FUNC)&LGBMTPU_BoosterUpdateOneIter_R, 1},
+    {"LGBMTPU_BoosterPredictForMat_R",
+     (DL_FUNC)&LGBMTPU_BoosterPredictForMat_R, 6},
+    {"LGBMTPU_BoosterSaveModel_R", (DL_FUNC)&LGBMTPU_BoosterSaveModel_R, 2},
+    {"LGBMTPU_BoosterSaveModelToString_R",
+     (DL_FUNC)&LGBMTPU_BoosterSaveModelToString_R, 1},
+    {"LGBMTPU_BoosterCreateFromModelfile_R",
+     (DL_FUNC)&LGBMTPU_BoosterCreateFromModelfile_R, 1},
+    {"LGBMTPU_BoosterNumberOfTotalModel_R",
+     (DL_FUNC)&LGBMTPU_BoosterNumberOfTotalModel_R, 1},
+    {nullptr, nullptr, 0}};
+
+void R_init_lightgbmtpu(DllInfo* dll) {
+  R_registerRoutines(dll, nullptr, kCallMethods, nullptr, nullptr);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

@@ -1,0 +1,56 @@
+/* SWIG interface for the lightgbm_tpu C ABI (the reference ships
+ * swig/lightgbmlib.i for its Java bindings; this file targets the same
+ * LGBM_* surface preserved by native/include/lightgbm_tpu_c_api.h).
+ *
+ * Language-agnostic: `swig -python` is built and TESTED in this repo
+ * (tests/test_swig_binding.py); `swig -java` generates the JNI wrapper +
+ * .java sources for hosts that have a JDK (none in this image — see
+ * native/BINDINGS.md).
+ */
+%module lightgbmlibtpu
+%{
+#include "../include/lightgbm_tpu_c_api.h"
+%}
+
+%include "stdint.i"
+%include "cpointer.i"
+%include "carrays.i"
+%include "cstring.i"
+
+/* out-params and buffer helpers, mirroring the reference's usage */
+%pointer_functions(int, intp)
+%pointer_functions(int32_t, int32tp)
+%pointer_functions(int64_t, int64tp)
+%pointer_functions(double, doublep)
+%pointer_functions(void*, voidpp)
+%array_class(double, doubleArray)
+%array_class(float, floatArray)
+%array_class(int32_t, int32Array)
+
+/* the save-to-string helper mallocs; SWIG frees after conversion */
+%newobject LGBM_BoosterSaveModelToStringSWIG;
+
+%inline %{
+/* typed-array -> const void* casts (SWIG keeps pointer types strict) */
+static const void* double_array_as_voidp(double* a) { return (const void*)a; }
+static const void* float_array_as_voidp(float* a) { return (const void*)a; }
+static const void* int32_array_as_voidp(int32_t* a) { return (const void*)a; }
+
+/* grow-a-string helper, the reference's SaveModelToStringSWIG idea */
+static char* LGBM_BoosterSaveModelToStringSWIG(void* handle,
+                                               int start_iteration,
+                                               int num_iteration) {
+  int64_t out_len = 0;
+  if (LGBM_BoosterSaveModelToString(handle, start_iteration, num_iteration,
+                                    0, &out_len, NULL) != 0) return NULL;
+  char* dst = (char*)malloc((size_t)out_len);
+  if (LGBM_BoosterSaveModelToString(handle, start_iteration, num_iteration,
+                                    out_len, &out_len, dst) != 0) {
+    free(dst);
+    return NULL;
+  }
+  return dst;  /* SWIG copies into the target language string */
+}
+%}
+
+%include "../include/lightgbm_tpu_c_api.h"

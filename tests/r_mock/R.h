@@ -1,0 +1,2 @@
+/* MINIMAL MOCK — see Rinternals.h in this directory. */
+#include "Rinternals.h"

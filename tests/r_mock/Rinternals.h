@@ -1,0 +1,64 @@
+/* MINIMAL MOCK of the R API for compile-checking the R-package glue in an
+ * image without R (tests/test_r_binding.py). Declarations only — shapes
+ * follow R's public API headers; NOT a functional implementation. */
+#ifndef LIGHTGBM_TPU_TEST_RINTERNALS_MOCK_H_
+#define LIGHTGBM_TPU_TEST_RINTERNALS_MOCK_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct SEXPREC* SEXP;
+typedef ptrdiff_t R_xlen_t;
+typedef int Rboolean;
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif
+
+#define REALSXP 14
+
+extern SEXP R_NilValue;
+
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+#define PROTECT(s) Rf_protect(s)
+#define UNPROTECT(n) Rf_unprotect(n)
+
+void Rf_error(const char*, ...);
+int Rf_asInteger(SEXP);
+SEXP Rf_asChar(SEXP);
+SEXP Rf_ScalarInteger(int);
+SEXP Rf_allocVector(unsigned int, R_xlen_t);
+SEXP Rf_mkString(const char*);
+int Rf_length(SEXP);
+const char* R_CHAR(SEXP);
+#define CHAR(x) R_CHAR(x)
+double* REAL(SEXP);
+int* INTEGER(SEXP);
+char* R_alloc(size_t, int);
+
+typedef void (*R_CFinalizer_t)(SEXP);
+SEXP R_MakeExternalPtr(void*, SEXP, SEXP);
+void* R_ExternalPtrAddr(SEXP);
+void R_ClearExternalPtr(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, Rboolean);
+
+typedef void* (*DL_FUNC)(void);
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+typedef struct _DllInfo DllInfo;
+void R_registerRoutines(DllInfo*, const void*, const R_CallMethodDef*,
+                        const void*, const void*);
+void R_useDynamicSymbols(DllInfo*, Rboolean);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* LIGHTGBM_TPU_TEST_RINTERNALS_MOCK_H_ */

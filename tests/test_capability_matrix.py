@@ -15,15 +15,14 @@ import pytest
 import lightgbm_tpu as lgb
 from lightgbm_tpu.log import LightGBMError
 
+from conftest import make_binary, make_multiclass
 
-def _data(multiclass=False, n=1200, f=6, seed=9):
-    r = np.random.RandomState(seed)
-    X = r.randn(n, f)
+
+def _data(multiclass=False, n=1200, f=6):
     if multiclass:
-        y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int))
-    else:
-        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
-    return X, y
+        X, y = make_multiclass(n=n, f=f, k=3)
+        return X, y.astype(int)
+    return make_binary(n=n, f=f)
 
 
 def _forced_file():

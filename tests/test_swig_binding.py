@@ -1,0 +1,105 @@
+"""SWIG binding over the C ABI (the reference's swig/lightgbmlib.i path,
+here native/swig/lightgbm_tpu.i): generate, compile, and DRIVE the
+wrapper — python target in-repo; the same .i generates the JNI/Java
+sources on hosts with a JDK (native/BINDINGS.md)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SWIG_DIR = os.path.join(REPO, "native", "swig")
+
+DRIVER = r"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys, os
+sys.path.insert(0, os.path.join(%(repo)r, "native", "swig"))
+os.environ["LIGHTGBM_TPU_PYROOT"] = %(repo)r
+import numpy as np
+import lightgbmlibtpu as L
+
+np.savetxt(%(csv)r, np.column_stack([
+    (np.random.RandomState(0).randn(1200, 5)[:, 0] > 0).astype(float),
+    np.random.RandomState(0).randn(1200, 5)]), delimiter=",")
+hp = L.new_voidpp()
+assert L.LGBM_DatasetCreateFromFile(%(csv)r, "max_bin=63", None, hp) == 0, \
+    L.LGBM_GetLastError()
+ds = L.voidpp_value(hp)
+nd = L.new_int32tp()
+assert L.LGBM_DatasetGetNumData(ds, nd) == 0
+assert L.int32tp_value(nd) == 1200
+bp = L.new_voidpp()
+assert L.LGBM_BoosterCreate(
+    ds, "objective=binary num_leaves=15 verbosity=-1", bp) == 0, \
+    L.LGBM_GetLastError()
+bst = L.voidpp_value(bp)
+fin = L.new_intp()
+for _ in range(5):
+    assert L.LGBM_BoosterUpdateOneIter(bst, fin) == 0
+# eval through the typed-array helpers
+cnt = L.new_intp()
+assert L.LGBM_BoosterGetEvalCounts(bst, cnt) == 0
+n_eval = L.intp_value(cnt)
+res = L.doubleArray(max(n_eval, 1))
+olen = L.new_intp()
+assert L.LGBM_BoosterGetEval(bst, 0, olen, res.cast()) == 0
+# save -> reload -> same iteration count
+s = L.LGBM_BoosterSaveModelToStringSWIG(bst, 0, -1)
+assert s and "tree" in s
+bp2 = L.new_voidpp()
+it2 = L.new_intp()
+assert L.LGBM_BoosterLoadModelFromString(s, it2, bp2) == 0
+assert L.intp_value(it2) == 5
+assert L.LGBM_BoosterFree(bst) == 0
+assert L.LGBM_BoosterFree(L.voidpp_value(bp2)) == 0
+assert L.LGBM_DatasetFree(ds) == 0
+print("SWIG_DRIVER_OK")
+"""
+
+
+@pytest.mark.skipif(shutil.which("swig") is None, reason="no swig")
+def test_swig_python_binding_end_to_end(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # (re)generate + build against the freshly built ABI library
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                        "lib_lightgbm.so"], capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-1000:]
+    r = subprocess.run(
+        ["swig", "-python", "-o", "lightgbm_tpu_wrap.c", "lightgbm_tpu.i"],
+        cwd=SWIG_DIR, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1000:]
+    r = subprocess.run(
+        "gcc -O2 -fPIC -shared lightgbm_tpu_wrap.c -o _lightgbmlibtpu.so "
+        "$(python3-config --includes) -L.. -l_lightgbm "
+        "-Wl,-rpath,'$ORIGIN/..'",
+        shell=True, cwd=SWIG_DIR, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stderr[-1000:]
+
+    script = DRIVER % {"repo": REPO,
+                       "csv": str(tmp_path / "swig_train.csv")}
+    r = subprocess.run([sys.executable, "-u", "-c", script], env=env,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=500)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-2000:])
+    assert "SWIG_DRIVER_OK" in r.stdout
+
+
+@pytest.mark.skipif(shutil.which("swig") is None, reason="no swig")
+def test_swig_java_sources_generate(tmp_path):
+    """The same .i produces the JNI wrapper + .java classes (buildable on
+    hosts with a JDK; none in this image)."""
+    out = tmp_path / "java"
+    out.mkdir()
+    r = subprocess.run(
+        ["swig", "-java", "-package", "io.lightgbm.tpu",
+         "-outdir", str(out), "-o", str(tmp_path / "wrap_java.c"),
+         "lightgbm_tpu.i"],
+        cwd=SWIG_DIR, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1000:]
+    javas = list(out.glob("*.java"))
+    assert any(j.name == "lightgbmlibtpu.java" for j in javas), javas
+    assert (tmp_path / "wrap_java.c").stat().st_size > 10000
