@@ -85,6 +85,15 @@ MATRIX = [
     ("mc-vmap", {"MULTICLASS": True}, dict(vmapped=True)),
     ("mc-pool-seq", {"MULTICLASS": True, "histogram_pool_size": 1e-4},
      dict(vmapped=False, pool=True)),
+    ("goss-batched", {"boosting": "goss", "tree_growth": "batched"},
+     dict(batch=True)),
+    ("dart-batched", {"boosting": "dart", "tree_growth": "batched"},
+     dict(batch=True)),
+    ("rf-batched", {"boosting": "rf", "tree_growth": "batched",
+                    "bagging_freq": 1, "bagging_fraction": 0.8},
+     dict(batch=True)),
+    ("mc-batched", {"MULTICLASS": True, "tree_growth": "batched"},
+     dict(batch=True, vmapped=True)),
 ]
 
 
