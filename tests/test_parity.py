@@ -50,12 +50,13 @@ def test_reference_model_file_predicts_identically():
     assert np.abs(prob - golden_prob).max() < 1e-6
 
 
-def _train_like_reference():
+def _train_like_reference(extra_params=None):
     X, y, _ = _load("binary.train")
     Xv, yv, _ = _load("binary.test")
     params = {"objective": "binary", "metric": ["auc", "binary_logloss"],
               "num_leaves": 31, "learning_rate": 0.1, "max_bin": 255,
-              "min_data_in_leaf": 20, "verbosity": -1}
+              "min_data_in_leaf": 20, "verbosity": -1,
+              **(extra_params or {})}
     dtr = lgb.Dataset(X, y)
     dv = lgb.Dataset(Xv, yv, reference=dtr)
     ev = {}
@@ -63,6 +64,25 @@ def _train_like_reference():
                     valid_names=["training", "valid_1"], evals_result=ev,
                     verbose_eval=False)
     return bst, ev
+
+
+def _assert_trajectory_budgets(ev):
+    """The ONE tolerance table for reference-trajectory parity (see
+    test_training_trajectory_matches_reference's docstring for why the
+    budgets are shaped this way)."""
+    traj = json.load(open(os.path.join(GOLDEN, "trajectory_ref.json")))
+    for ds in ("training", "valid_1"):
+        for metric, tol, final_tol in (
+                ("auc", 2.5e-3 if ds == "training" else 8e-3,
+                 8e-4 if ds == "training" else 2.5e-3),
+                ("binary_logloss", 5e-3 if ds == "training" else 8e-3,
+                 1.5e-3 if ds == "training" else 3e-3)):
+            ref_series = [v for _, v in traj[ds][metric]]
+            ours = ev[ds][metric]
+            assert len(ours) == len(ref_series), (ds, metric, len(ours))
+            diffs = np.abs(np.asarray(ours) - np.asarray(ref_series))
+            assert diffs.max() < tol, (ds, metric, diffs.max())
+            assert diffs[-1] < final_tol, (ds, metric, diffs[-1])
 
 
 @needs_ref_data
@@ -75,19 +95,7 @@ def test_training_trajectory_matches_reference():
     the ~1e-3 level mid-run but must land together: the final values are
     held to a much tighter budget."""
     _, ev = _train_like_reference()
-    traj = json.load(open(os.path.join(GOLDEN, "trajectory_ref.json")))
-    for ds in ("training", "valid_1"):
-        for metric, tol, final_tol in (
-                ("auc", 2.5e-3 if ds == "training" else 8e-3,
-                 8e-4 if ds == "training" else 2.5e-3),
-                ("binary_logloss", 5e-3 if ds == "training" else 8e-3,
-                 1.5e-3 if ds == "training" else 3e-3)):
-            ref_series = [v for _, v in traj[ds][metric]]
-            ours = ev[ds][metric]
-            assert len(ours) == len(ref_series)
-            diffs = np.abs(np.asarray(ours) - np.asarray(ref_series))
-            assert diffs.max() < tol, (ds, metric, diffs.max())
-            assert diffs[-1] < final_tol, (ds, metric, diffs[-1])
+    _assert_trajectory_budgets(ev)
 
 
 @needs_ref_data
@@ -201,3 +209,13 @@ def test_feature_infos_parity():
 
     for (a1, b1), (a2, b2) in zip(ranges(ours), ranges(ref)):
         assert abs(a1 - a2) < 1e-12 and abs(b1 - b2) < 1e-12
+
+
+@needs_ref_data
+def test_batched_k1_training_trajectory_matches_reference():
+    """tree_growth=batched with tree_batch_splits=1 IS the exact algorithm
+    (test_grow_batched pins structural identity vs exact mode); it must
+    therefore also hold the golden reference-trajectory budgets."""
+    _, ev = _train_like_reference(
+        {"tree_growth": "batched", "tree_batch_splits": 1})
+    _assert_trajectory_budgets(ev)
