@@ -379,6 +379,7 @@ class GBDT:
             vmapped_classes=(self.num_tree_per_iteration > 1
                              and pool_slots == 0),
             batch_splits=batch_splits,
+            batched_pack=(batch_splits > 0 and cfg.tpu_batched_pack),
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the

@@ -172,6 +172,10 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # GPU-Performance.rst:132-139). See core/grow_batched.py.
     ("tree_growth", str, "exact", ["growth_mode"]),
     ("tree_batch_splits", int, 16, []),
+    # batched growth: pack active rows so dead row tiles skip the slot
+    # kernel's compute (cost ~ split-leaf rows, not N); opt-in until
+    # measured on chip
+    ("tpu_batched_pack", bool, False, []),
 ]
 
 _CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}

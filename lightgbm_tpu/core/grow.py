@@ -107,6 +107,10 @@ class GrowParams(NamedTuple):
     # of the highest-gain frontier leaves per sequential step instead of
     # exactly one. 0 = exact leaf-wise (the reference's semantics)
     batch_splits: int = 0
+    # pack active rows to the front each batched step so all-inactive row
+    # tiles skip the slot kernel's compute body (tpu_batched_pack; opt-in
+    # until measured on chip)
+    batched_pack: bool = False
 
 
 class TreeArrays(NamedTuple):

@@ -72,7 +72,8 @@ class _BatchState(NamedTuple):
     leaf_max: jnp.ndarray     # [L] f32 monotone upper bound
 
 
-def _combined_hist(xb, slot, grad, hess, hmask, b, kb, impl, row_chunk):
+def _combined_hist(xb, slot, active, grad, hess, hmask, b, kb, impl,
+                   row_chunk, pack):
     """All 2K children's [C, B, 3] histograms in one pass over the rows.
 
     Pallas spellings use the slot-extended digit kernel (the combined
@@ -80,9 +81,28 @@ def _combined_hist(xb, slot, grad, hess, hmask, b, kb, impl, row_chunk):
     build over the combined index directly — fine on CPU, but a matmul
     one-hot of width 2K*B would be enormous on device, which is exactly
     why the slot kernel exists.
+
+    ``pack`` (tpu_batched_pack): gather the ACTIVE rows (those inside a
+    splitting leaf) to the front with a stable cumsum partition before
+    the kernel, and mark everything behind them slot -1 — all-inactive
+    row tiles then skip their compute body (pl.when), so per-step kernel
+    cost tracks the split leaves' rows instead of N. Costs one [N, C]
+    gather + one scatter per step; opt-in until measured on chip.
     """
     if impl.startswith("pallas"):
         from .histogram_pallas import build_histogram_slots
+        if pack:
+            n = slot.shape[0]
+            act32 = active.astype(jnp.int32)
+            na = jnp.cumsum(act32)
+            total = na[-1]
+            pos = jnp.where(active, na - 1,
+                            total + jnp.cumsum(1 - act32) - 1)
+            perm = jnp.zeros((n,), jnp.int32).at[pos].set(
+                jnp.arange(n, dtype=jnp.int32))
+            xb = jnp.take(xb, perm, axis=0)
+            slot = jnp.where(active, slot, -1)[perm]
+            grad, hess, hmask = grad[perm], hess[perm], hmask[perm]
         vals = jnp.stack([grad * hmask, hess * hmask, hmask], axis=0)
         out = build_histogram_slots(
             xb, slot, vals, num_bins=b, n_slots=2 * kb,
@@ -195,9 +215,9 @@ def grow_tree_batched(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # child slot = 2*rank + side; combined bin index = slot*B + bin.
         slot = jnp.where(active, rs * 2 + (~go_left).astype(jnp.int32), 0)
         hmask = sample_mask * active.astype(jnp.float32)
-        ch_hist = psum(_combined_hist(xb, slot, grad, hess, hmask, b, kb,
-                                      params.hist_impl,
-                                      params.row_chunk))  # [2K, C, B, 3]
+        ch_hist = psum(_combined_hist(
+            xb, slot, active, grad, hess, hmask, b, kb, params.hist_impl,
+            params.row_chunk, params.batched_pack))       # [2K, C, B, 3]
 
         # ---- tree bookkeeping for up to K splits (Tree::Split, x K) -----
         safe_leaf = jnp.where(valid, gleaf, l - 1)

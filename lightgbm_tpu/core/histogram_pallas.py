@@ -166,21 +166,36 @@ def _hist_slot_kernel(xb_ref, slot_ref, vals_ref, out_ref, *, hi_n: int,
     value channels are zero (masked / not in any split leaf) contribute
     nothing regardless of slot id.
 
-    xb_ref: [Ft, C] uint8; slot_ref: [1, C] int32; vals_ref: [K, C] f32;
-    out_ref: [K, Ft, Hi, S*16] f32 (lo is minor so the RHS one-hot needs
-    no in-kernel transpose; the caller reorders to [S, F, B, K]).
+    xb_ref: [Ft, C] uint8; slot_ref: [1, C] int32 (-1 = row inactive this
+    step); vals_ref: [K, C] f32; out_ref: [K, Ft, Hi, S*16] f32 (lo is
+    minor so the RHS one-hot needs no in-kernel transpose; the caller
+    reorders to [S, F, B, K]).
+
+    A row tile whose slots are ALL -1 skips its entire compute body —
+    with actives packed to the front (grow_batched's tpu_batched_pack),
+    per-step cost becomes proportional to the split leaves' rows instead
+    of N.
     """
     r = pl.program_id(1)
-    xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
     slot = slot_ref[...].astype(jnp.int32)                   # [1, C]
     vals = vals_ref[...]                                     # [K, C]
-    ft, c = xb.shape
     k = vals.shape[0]
+    ft = xb_ref.shape[0]
+    c = slot.shape[1]
 
     @pl.when(r == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
+    @pl.when(jnp.any(slot >= 0))
+    def _body():
+        _hist_slot_tile(xb_ref, slot, vals, out_ref, hi_n=hi_n,
+                        n_slots=n_slots, highest=highest, k=k, ft=ft, c=c)
+
+
+def _hist_slot_tile(xb_ref, slot, vals, out_ref, *, hi_n, n_slots, highest,
+                    k, ft, c):
+    xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (hi_n, c), 0)
     iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_slots, c), 0)
@@ -224,8 +239,10 @@ def build_histogram_slots(xb: jnp.ndarray, slot: jnp.ndarray,
     [n_slots, F, B, K] f32 histograms — every slot's histogram in ONE pass
     over the rows (the multi-leaf step of batched-frontier growth).
 
-    Rows outside every slot must carry zero value channels; their slot id
-    is ignored (clamped into range)."""
+    Rows outside every slot should carry slot -1 (matches no one-hot AND
+    lets an all-inactive row tile skip its compute body entirely); zero
+    value channels keep them harmless either way. Padding rows are
+    slot -1."""
     n, f = xb.shape
     k = vals.shape[0]
     hi_n = max(1, (num_bins + 15) // 16)
@@ -233,8 +250,9 @@ def build_histogram_slots(xb: jnp.ndarray, slot: jnp.ndarray,
     f_pad = (-f) % feature_tile
     n_pad = (-n) % row_tile
     xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad))).astype(jnp.uint8)
-    slot2 = jnp.clip(slot.astype(jnp.int32), 0, n_slots - 1)
-    slot2 = jnp.pad(slot2, (0, n_pad))[None, :]              # [1, N+pad]
+    slot2 = jnp.minimum(slot.astype(jnp.int32), n_slots - 1)
+    slot2 = jnp.pad(slot2, (0, n_pad),
+                    constant_values=-1)[None, :]             # [1, N+pad]
     vals = jnp.pad(vals, ((0, 0), (0, n_pad)))
     fp = f + f_pad
 

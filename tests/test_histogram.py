@@ -221,3 +221,35 @@ def test_slot_kernel_matches_per_slot_scatter():
                 jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h),
                 jnp.asarray(msk), num_bins=b, impl="scatter"))
             np.testing.assert_allclose(out[si], ref, rtol=1e-4, atol=1e-3)
+
+
+def test_slot_kernel_sentinel_rows_skip_and_match():
+    """slot = -1 rows contribute nothing (match no one-hot), and a row
+    tile that is ALL -1 skips its compute body (pl.when) — results must
+    equal the reference computed over the active prefix only."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.histogram import build_histogram
+    from lightgbm_tpu.core.histogram_pallas import build_histogram_slots
+    r = np.random.RandomState(33)
+    n, f, b, s = 6000, 4, 64, 4
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = np.abs(r.randn(n)).astype(np.float32)
+    # actives packed to the front (what tpu_batched_pack produces); the
+    # tail spans multiple whole row tiles of -1
+    n_active = 1500
+    slot = np.full(n, -1, np.int32)
+    slot[:n_active] = r.randint(0, s, n_active)
+    m = np.zeros(n, np.float32)
+    m[:n_active] = 1.0
+    vals = jnp.stack([jnp.asarray(g * m), jnp.asarray(h * m),
+                      jnp.asarray(m)], axis=0)
+    out = np.asarray(build_histogram_slots(
+        jnp.asarray(xb), jnp.asarray(slot), vals, num_bins=b, n_slots=s,
+        interpret=True))
+    for si in range(s):
+        msk = (slot == si).astype(np.float32)
+        ref = np.asarray(build_histogram(
+            jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h),
+            jnp.asarray(msk), num_bins=b, impl="scatter"))
+        np.testing.assert_allclose(out[si], ref, rtol=1e-4, atol=1e-3)
