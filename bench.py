@@ -31,15 +31,19 @@ BASELINE_ITERS_PER_SEC = 500.0 / 238.505   # docs/Experiments.rst:104-112
 def _probe_backend(timeout_s: float) -> dict:
     """Try jax backend init in a subprocess (it can hang, not just raise)."""
     code = ("import jax; d = jax.devices(); "
-            "print('PROBE_OK', jax.default_backend(), len(d))")
+            "print('PROBE_OK', jax.default_backend(), len(d), "
+            "repr(getattr(d[0], 'device_kind', '?')).replace(' ', '_'))")
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=timeout_s)
         out = (r.stdout or "") + (r.stderr or "")
         for line in (r.stdout or "").splitlines():
             if line.startswith("PROBE_OK"):
-                _, backend, ndev = line.split()
-                return {"ok": True, "backend": backend, "n_devices": int(ndev)}
+                parts = line.split()
+                backend, ndev = parts[1], parts[2]
+                kind = parts[3].strip("'\"") if len(parts) > 3 else ""
+                return {"ok": True, "backend": backend,
+                        "n_devices": int(ndev), "device_kind": kind}
         return {"ok": False, "error": out[-500:] or ("rc=%d" % r.returncode)}
     except subprocess.TimeoutExpired:
         return {"ok": False, "error": "backend init timed out after %.0fs"
@@ -155,7 +159,15 @@ def run_bench(backend_info: dict) -> dict:
     # touched once per tree LEVEL it passes through). v5e peak ~197 TFLOPS
     # bf16. GBDT is latency/VPU-bound, not matmul-dense — the point of the
     # number is the denominator, not a target of 1.0.
-    v5e_peak_flops = 197e12
+    # pick the bf16 peak for the chip generation that actually ran
+    # (public peak numbers; default to v5e when the kind is unknown)
+    _PEAKS = {"v4": 275e12, "v5e": 197e12,
+              "v5p": 459e12, "v6e": 918e12, "trillium": 918e12}
+    kind = str(backend_info.get("device_kind", "")).lower() \
+        .replace(" ", "").replace("_", "")
+    # normalize lite-generation names: 'tpuv6lite' -> v6e, 'v5lite*' -> v5e
+    kind = kind.replace("v6lite", "v6e").replace("v5lite", "v5e")
+    peak_flops = next((v for k, v in _PEAKS.items() if k in kind), 197e12)
     flops_per_visit = 3 * 256 * 2 * 2.0
     depth_avg = max(1.0, np.ceil(np.log2(max(num_leaves, 2))))
     # only meaningful for an honest TPU run: zeroed with the throughput
@@ -163,7 +175,7 @@ def run_bench(backend_info: dict) -> dict:
     # roofline for a CPU-fallback run
     if train_auc_ok and not backend_info.get("fallback"):
         mfu = (iters_per_sec * n * f * depth_avg * flops_per_visit
-               / v5e_peak_flops)
+               / peak_flops)
     else:
         mfu = 0.0
     return {

@@ -497,10 +497,18 @@ def dataset_get_field(ds, name: str):
         return code, None
     arr = np.ascontiguousarray(np.asarray(arr), dtype=dt)
     if not hasattr(ds, "_capi_field_cache"):
-        ds._capi_field_cache = []
-    # append, never replace: every pointer ever handed to C stays valid
-    # until the handle is freed (the header's lifetime contract)
-    ds._capi_field_cache.append(arr)
+        ds._capi_field_cache = {}
+    pinned = ds._capi_field_cache.setdefault(name, [])
+    # Every pointer ever handed to C stays valid until the handle is
+    # freed (the header's lifetime contract, c_api.h:335-339), so pinned
+    # arrays are never dropped — but a caller polling an unchanged field
+    # gets the same pinned array back instead of growing the pin list.
+    if pinned:
+        cached = pinned[-1]
+        if cached.shape == arr.shape and cached.dtype == arr.dtype \
+                and np.array_equal(cached, arr, equal_nan=True):
+            return code, cached
+    pinned.append(arr)
     return code, arr
 
 
@@ -516,11 +524,44 @@ def dataset_get_subset(ds, idx_mv: memoryview, num_used: int,
     return sub
 
 
+# Parameters baked into the binned representation at construction time;
+# Dataset::ResetConfig refuses to change them on a live handle
+# (dataset.cpp:327-348). We reject rather than warn so C callers can't
+# silently train with a stale max_bin.
+_BIN_AFFECTING = frozenset([
+    "max_bin", "bin_construct_sample_cnt", "min_data_in_bin",
+    "use_missing", "zero_as_missing", "sparse_threshold",
+])
+
+
 def dataset_update_param(ds, params: str) -> None:
     p = parse_params(params)
     ds = _as_dataset(ds)
     if ds.params is None:
         ds.params = {}
+    if ds._binned is not None:
+        from .config import _CANON, Config, _coerce
+        from .log import Log
+        # authoritative: the effective values recorded when the binned
+        # representation was built (survives .bin round-trips and subsets)
+        effective = getattr(ds._binned, "bin_params", {}) or {}
+        for k, v in p.items():
+            ck = Config.resolve_key(k)
+            if ck not in _BIN_AFFECTING:
+                continue
+            cur = effective.get(ck)
+            if cur is None and ck == "max_bin":
+                cur = ds._binned.max_bin
+            if cur is None:
+                # pre-bin_params .bin file: can't verify — warn like the
+                # reference's ResetConfig and accept
+                Log.warning("Cannot verify %s against the constructed "
+                            "Dataset; accepting unchecked." % ck)
+                continue
+            ty = _CANON.get(ck, (str, None))[0]
+            if _coerce(ck, ty, cur) != _coerce(ck, ty, v):
+                raise LightGBMError(
+                    "Cannot change %s after constructed Dataset handle." % ck)
     ds.params.update(p)
 
 
@@ -705,7 +746,12 @@ def booster_shuffle_models(bst: Booster, start_iter: int,
     n_iter = len(models) // k
     lo = max(0, start_iter)
     hi = n_iter if end_iter <= 0 else min(end_iter, n_iter)
-    perm = np.random.RandomState(impl.config.seed).permutation(
+    # deterministic but distinct across successive calls: fold a
+    # per-booster shuffle counter into the seed
+    n_shuffles = getattr(impl, "_n_model_shuffles", 0)
+    impl._n_model_shuffles = n_shuffles + 1
+    perm = np.random.RandomState(
+        (impl.config.seed + n_shuffles) % (2 ** 31)).permutation(
         np.arange(lo, hi))
     shuffled = list(models)
     for dst_it, src_it in zip(range(lo, hi), perm):

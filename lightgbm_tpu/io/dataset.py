@@ -126,7 +126,21 @@ class BinnedDataset:
         self.metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin: int = 255
+        # effective values of construction-time params that the binned
+        # representation depends on (Dataset::ResetConfig's immutable set,
+        # dataset.cpp:327-348); authoritative for post-construct
+        # update-param checking even when the handle came from a .bin file
+        self.bin_params: Dict[str, Any] = {}
         self._device_cache: Dict[Any, Any] = {}
+
+    _BIN_PARAM_KEYS = ("max_bin", "bin_construct_sample_cnt",
+                       "min_data_in_bin", "use_missing", "zero_as_missing",
+                       "sparse_threshold")
+
+    def _record_bin_params(self, config: Config) -> None:
+        self.bin_params = {k: getattr(config, k)
+                           for k in self._BIN_PARAM_KEYS
+                           if hasattr(config, k)}
 
     # ------------------------------------------------------------ construct
     @classmethod
@@ -159,6 +173,7 @@ class BinnedDataset:
         self.num_data = n
         self.num_total_features = f
         self.max_bin = config.max_bin
+        self._record_bin_params(config)
         self.feature_names = feature_names or ["Column_%d" % i for i in range(f)]
 
         def column_nonzeros(j):
@@ -462,6 +477,7 @@ class BinnedDataset:
         self.num_data = n_local
         self.num_total_features = f
         self.max_bin = config.max_bin
+        self._record_bin_params(config)
         self.feature_names = names
         self.bin_mappers = mappers
         self.used_features = [j for j in range(f) if not mappers[j].is_trivial]
@@ -637,6 +653,7 @@ class BinnedDataset:
             "used_features": self.used_features,
             "feature_names": self.feature_names,
             "max_bin": self.max_bin,
+            "bin_params": self.bin_params,
             "bin_mappers": [m.to_dict() for m in self.bin_mappers],
             "col_features": self.col_features,
             "col_offsets": self.col_offsets,
@@ -669,6 +686,7 @@ class BinnedDataset:
             self.used_features = list(meta["used_features"])
             self.feature_names = list(meta["feature_names"])
             self.max_bin = meta["max_bin"]
+            self.bin_params = dict(meta.get("bin_params", {}))
             self.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
             self.col_features = [list(b) for b in meta.get(
                 "col_features", [[j] for j in self.used_features])]

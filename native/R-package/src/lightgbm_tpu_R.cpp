@@ -78,14 +78,25 @@ SEXP LGBMTPU_DatasetSetField_R(SEXP handle, SEXP name, SEXP values) {
   int n = Rf_length(values);
   if (std::strcmp(field, "group") == 0 ||
       std::strcmp(field, "query") == 0) {
+    SEXP iv = PROTECT(Rf_coerceVector(values, INTSXP));
     check_call(LGBM_DatasetSetField(unwrap(handle), field,
-                                    INTEGER(values), n,
+                                    INTEGER(iv), n,
                                     C_API_DTYPE_INT32));
+    UNPROTECT(1);
+  } else if (std::strcmp(field, "init_score") == 0) {
+    /* init_score is float64 on the ABI (c_api.h SetField contract) —
+       pass R's doubles through untruncated (coerce handles INTSXP) */
+    SEXP dv = PROTECT(Rf_coerceVector(values, REALSXP));
+    check_call(LGBM_DatasetSetField(unwrap(handle), field, REAL(dv),
+                                    n, C_API_DTYPE_FLOAT64));
+    UNPROTECT(1);
   } else {
     /* label/weight are float32 on the ABI */
+    SEXP dv = PROTECT(Rf_coerceVector(values, REALSXP));
     float* buf = (float*)R_alloc(n, sizeof(float));
-    double* src = REAL(values);
+    double* src = REAL(dv);
     for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+    UNPROTECT(1);
     check_call(LGBM_DatasetSetField(unwrap(handle), field, buf, n,
                                     C_API_DTYPE_FLOAT32));
   }

@@ -19,6 +19,7 @@ typedef int Rboolean;
 #endif
 
 #define REALSXP 14
+#define INTSXP 13
 
 extern SEXP R_NilValue;
 
@@ -32,6 +33,7 @@ int Rf_asInteger(SEXP);
 SEXP Rf_asChar(SEXP);
 SEXP Rf_ScalarInteger(int);
 SEXP Rf_allocVector(unsigned int, R_xlen_t);
+SEXP Rf_coerceVector(SEXP, unsigned int);
 SEXP Rf_mkString(const char*);
 int Rf_length(SEXP);
 const char* R_CHAR(SEXP);

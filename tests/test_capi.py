@@ -259,6 +259,13 @@ def test_capi_round3_surface(lib_path, tmp_path):
 
     # --- UpdateParam / DumpText
     assert lib.LGBM_DatasetUpdateParam(ds, b"data_random_seed=5") == 0
+    # bin-affecting params cannot change on a constructed handle
+    # (Dataset::ResetConfig, dataset.cpp:327-348; we error where the
+    # reference warns, so callers can't train against a stale max_bin)
+    assert lib.LGBM_DatasetUpdateParam(ds, b"max_bin=7") != 0
+    assert b"max_bin" in lib.LGBM_GetLastError()
+    # unchanged value is fine (the handle was built with max_bin=63)
+    assert lib.LGBM_DatasetUpdateParam(ds, b"max_bin=63") == 0
     txt = str(tmp_path / "dump.txt")
     assert lib.LGBM_DatasetDumpText(sub, txt.encode()) == 0
     assert os.path.getsize(txt) > 0
