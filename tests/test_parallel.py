@@ -379,3 +379,26 @@ def test_voting_reduces_only_elected_histograms():
         "a full-width histogram crossed the mesh: %r" % (big,)
     # and the elected reduction itself must have happened
     assert any(s[0] == 2 * top_k for s in big), big
+
+
+def test_voting_on_2d_mesh_slow_axis():
+    """Multi-slice-shaped config: a [4, 2] (data x feature) mesh with the
+    PV-Tree vote riding the SLOW (data) axis — the deployment the voting
+    learner exists for (ICI-cheap elected-candidate psum across slices).
+    Election semantics must hold with 4 data shards, and the result must
+    match the 1-D mesh voting run."""
+    X, y = _voting_construction(n_dev=4, m=400)
+    b2d = _train({"objective": "binary", "metric": "auc",
+                  "tree_learner": "voting", "top_k": 2,
+                  "mesh_shape": [4, 2], "num_leaves": 4,
+                  "min_data_in_leaf": 5, "verbosity": -1}, X, y, rounds=2)
+    assert b2d.mesh is not None and b2d.mesh.shape["data"] == 4 \
+        and b2d.mesh.shape["feature"] == 2
+    assert int(b2d.models[0].split_feature[0]) == 0
+    b1d = _train({"objective": "binary", "metric": "auc",
+                  "tree_learner": "voting", "top_k": 2,
+                  "mesh_shape": [4], "num_leaves": 4,
+                  "min_data_in_leaf": 5, "verbosity": -1}, X, y, rounds=2)
+    np.testing.assert_allclose(
+        b2d.predict(X[:300], raw_score=True),
+        b1d.predict(X[:300], raw_score=True), rtol=1e-5, atol=1e-5)
