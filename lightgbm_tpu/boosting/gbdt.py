@@ -17,7 +17,8 @@ Key mappings:
 - Tree::Shrinkage (tree.h:139) -> leaf values scaled by learning_rate when a
   tree is extracted into the host-side model list.
 - RenewTreeOutput for percentile objectives (serial_tree_learner.cpp:850-928)
-  -> host-side weighted percentile per leaf (device port planned).
+  -> in-graph segmented weighted percentile (core/renew.py): one sort +
+  cumsum + searchsorted renews every leaf at once, no host round-trip.
 """
 from __future__ import annotations
 
@@ -162,19 +163,40 @@ def _feature_meta_from_dataset(ds: BinnedDataset, config: Config) -> FeatureMeta
         pack_partner=jnp.asarray(pack_partner))
 
 
+def _hist_dtype(cfg: Config) -> str:
+    """Histogram accumulation dtype: tpu_hist_dtype is the explicit knob,
+    gpu_use_dp (config.h:784) the reference-compatible alias for f64."""
+    spelled = str(cfg.tpu_hist_dtype).strip().lower()
+    if spelled in ("float64", "f64", "double"):
+        return "f64"
+    if spelled not in ("float32", "f32", "single", ""):
+        raise LightGBMError("unknown tpu_hist_dtype %r "
+                            "(use float32 or float64)" % cfg.tpu_hist_dtype)
+    return "f64" if cfg.gpu_use_dp else "f32"
+
+
 def _resolve_hist_impl(cfg: Config) -> str:
     """Histogram-kernel dispatch (the GPUTreeLearner device-path analog,
     tree_learner.cpp:9-31): CPU -> XLA scatter-add; device -> the Pallas
     VMEM-accumulator kernel, with one-hot matmul as the explicit fallback.
-    gpu_use_dp (config.h:784) upgrades ANY pallas spelling — auto or
-    explicit — to its full-f32 Precision.HIGHEST variant."""
+    gpu_use_dp (config.h:784) means what it means in the reference:
+    DOUBLE-precision histogram accumulation. The Pallas kernels are
+    f32-only, so dp routes to the XLA paths (scatter / one-hot matmul),
+    which accumulate in the value dtype — f64 once the GBDT driver casts
+    the stacked values (GrowParams.hist_dtype). Users who want the f32
+    Precision.HIGHEST kernel without f64 cost ask for
+    tpu_hist_impl=pallas_highest explicitly."""
     impl = cfg.tpu_hist_impl
+    if _hist_dtype(cfg) == "f64":
+        if impl == "auto" or impl.startswith("pallas"):
+            if impl.startswith("pallas"):
+                Log.warning("f64 histograms: the f32-only Pallas kernel "
+                            "%s is replaced by the f64 XLA path" % impl)
+            return ("scatter" if jax.default_backend() == "cpu"
+                    else "matmul")
+        return impl
     if impl == "auto":
         impl = ("scatter" if jax.default_backend() == "cpu" else "pallas")
-    if cfg.gpu_use_dp and impl.startswith("pallas") \
-            and "highest" not in impl:
-        impl = ("pallas_highest_interpret" if impl.endswith("interpret")
-                else "pallas_highest")
     return impl
 
 
@@ -188,6 +210,13 @@ class GBDT:
                  objective: Optional[ObjectiveFunction],
                  metrics: Optional[List[Metric]] = None):
         self.config = config
+        if _hist_dtype(config) == "f64" and not jax.config.jax_enable_x64:
+            # reference gpu_use_dp = double-precision histograms
+            # (config.h:784); jax needs x64 enabled for f64 to exist at
+            # trace time. Process-wide, explicit user opt-in.
+            Log.info("gpu_use_dp=true: enabling jax x64 mode for "
+                     "double-precision histogram accumulation")
+            jax.config.update("jax_enable_x64", True)
         self.train_data = train_data
         self.objective = objective
         self.train_metrics = metrics or []
@@ -274,6 +303,7 @@ class GBDT:
         self._explicit_fp = (
             self.mesh is not None
             and cfg.tree_learner == "feature"
+            and _hist_dtype(cfg) == "f32"  # sync_best_split bitcasts f32
             and mesh_mod.FEATURE_AXIS in self.mesh.axis_names
             and not cfg.forcedsplits_filename
             and not cfg.cegb_penalty_feature_coupled
@@ -331,7 +361,14 @@ class GBDT:
                 raise LightGBMError(
                     "tree_growth=batched supports the serial and data tree "
                     "learners only (got tree_learner=%s)" % cfg.tree_learner)
-            batch_splits = min(cfg.tree_batch_splits, cfg.num_leaves - 1)
+            if _hist_dtype(cfg) == "f64":
+                # grow_tree_batched accumulates f32 (slot kernel layout);
+                # silently downgrading would betray the dp promise
+                Log.warning("tree_growth=batched does not support f64 "
+                            "histograms yet; falling back to exact growth")
+            else:
+                batch_splits = min(cfg.tree_batch_splits,
+                                   cfg.num_leaves - 1)
 
         # explicit shard_map data-parallel learner: every device partitions
         # its local row shard and only child histograms cross the mesh
@@ -370,6 +407,7 @@ class GBDT:
             # kernel is the default device path (the GPUTreeLearner analog,
             # gpu_tree_learner.cpp:951-1045) — one-hot matmul is the fallback
             hist_impl=_resolve_hist_impl(cfg),
+            hist_dtype=_hist_dtype(cfg),
             voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
                           and self.mesh is not None else 0),
             with_categorical=bool(np.asarray(self.feature_meta.is_categorical)
@@ -674,6 +712,16 @@ class GBDT:
             goss_multiply = float(n_real - top_cnt) / other_cnt
 
         forced_splits = self._forced_splits
+        # RenewTreeOutput objectives (L1/Quantile/MAPE): leaf refit runs
+        # IN-GRAPH (core/renew.py) — no host round-trip, and train_many
+        # block fusion stays eligible
+        renew_alpha = None
+        renew_w_attr = None
+        if not use_input and obj is not None \
+                and getattr(obj, "renew_percentile", None) is not None:
+            renew_alpha = float(obj.renew_percentile())
+            renew_w_attr = ("label_weight" if obj.name == "mape"
+                            else "weights")
 
         def run_iter(xb, obj_rows, fp_capture, scores, sample_mask,
                      feature_mask, grad_in, hess_in, lr, goss_active,
@@ -824,6 +872,28 @@ class GBDT:
                     row_used=jnp.max(cegb_out.row_used, axis=0))
             else:
                 cegb_new = None
+            if renew_alpha is not None:
+                # device RenewTreeOutput (serial_tree_learner.cpp:850-928):
+                # refit leaf values to the weighted percentile of residuals
+                # against the PRE-update scores, exactly like the
+                # reference's post-growth renew
+                from ..core.renew import renew_leaf_values
+                rw = getattr(o, renew_w_attr, None)
+                if rw is None:
+                    rw = jnp.ones_like(o.label)
+
+                def renew_one(t, li, sc_col):
+                    # scores live in the (possibly reg_sqrt-transformed)
+                    # label space the gradients were computed in
+                    lab = getattr(o, "trans_label", None)
+                    lab = o.label if lab is None else lab
+                    new_lv = renew_leaf_values(
+                        lab - sc_col, rw, li, sample_mask,
+                        params.num_leaves, renew_alpha, t.leaf_value)
+                    return t._replace(leaf_value=new_lv)
+
+                trees = jax.vmap(renew_one, in_axes=(0, 0, 1))(
+                    trees, leaf_ids, scores)
             # score update fast path: leaf_id -> leaf_value (shrinkage applied)
             deltas = jax.vmap(
                 lambda t, li: t.leaf_value[li] * lr)(trees, leaf_ids)  # [K, N]
@@ -899,11 +969,11 @@ class GBDT:
         """Run ``num_iters`` iterations, fusing them into on-device blocks
         when no per-iteration host work is required. Returns True when
         training stopped. Boosting modes with per-iteration host logic
-        (DART's drop sets, RF's re-averaging, percentile-renew objectives,
-        custom gradients) fall back to the per-iteration path.
+        (DART's drop sets, RF's re-averaging, custom gradients) fall back
+        to the per-iteration path; percentile-renew objectives fuse fine —
+        their leaf refit runs in-graph (core/renew.py).
         """
         eligible = (self.boosting_type in ("gbdt", "goss")
-                    and not self._needs_host_per_iter
                     and not self._use_input_grads)
         if not eligible:
             for _ in range(num_iters):
@@ -963,10 +1033,6 @@ class GBDT:
         self._stopped_dev = jnp.asarray(False)
         self._models = list(value)
 
-    @property
-    def _needs_host_per_iter(self) -> bool:
-        return getattr(self.objective, "renew_percentile", None) is not None
-
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp TrainOneIter:333-412).
@@ -1002,7 +1068,6 @@ class GBDT:
             h_in = jnp.ones((n, k), jnp.float32)
 
         self._bag_key, goss_key = jax.random.split(self._bag_key)
-        prev_scores = self.scores
         packed, leaf_ids, new_scores, cegb_new, self._stopped_dev = \
             self._compiled_iter(
                 *self._iter_capture,
@@ -1016,13 +1081,9 @@ class GBDT:
         pend: Dict[str, Any] = {"packed": packed[None],  # [1, K, T] block
                                 "shrinkage": self.shrinkage_rate,
                                 "count": 1}
-        if self._needs_host_per_iter:
-            pend.update(leaf_ids=leaf_ids, sample_mask=sample_mask,
-                        prev_scores=prev_scores)
         self._pending.append(pend)
         self.iter_ += 1
-        if self._needs_host_per_iter or \
-                sum(p["count"] for p in self._pending) >= self._flush_every:
+        if sum(p["count"] for p in self._pending) >= self._flush_every:
             return self._materialize()
         return False
 
@@ -1084,13 +1145,6 @@ class GBDT:
         """Renew/shrink/bias-fold one flushed iteration's trees and append
         them to the model list (the tail of the reference's TrainOneIter)."""
         k = self.num_tree_per_iteration
-        # leaf renewal for percentile objectives (RenewTreeOutput,
-        # serial_tree_learner.cpp:850-928)
-        if self._needs_host_per_iter:
-            self.scores = self._renew_tree_outputs(
-                host_trees, pend["leaf_ids"], pend["sample_mask"],
-                pend["prev_scores"])
-
         first_iter = not self._models
         for ht in host_trees:
             ht.shrink(pend["shrinkage"])
@@ -1106,41 +1160,6 @@ class GBDT:
                     ht.leaf_value += float(inits[c])
                     ht.internal_value += float(inits[c])
         self._models.extend(host_trees)
-
-    def _renew_tree_outputs(self, host_trees: List[HostTree],
-                            leaf_ids, sample_mask,
-                            prev_scores) -> jnp.ndarray:
-        """Percentile leaf refit for L1/quantile/MAPE objectives
-        (regression_objective.hpp RenewTreeOutput; host-side for now).
-        ``prev_scores`` are the scores BEFORE this iteration's tree."""
-        alpha = self.objective.renew_percentile()
-        n0 = self.num_data_orig
-        # host() = pre-pad, pre-shard copies — never np.asarray a possibly
-        # mesh-sharded array (not addressable from one process)
-        label = self.objective.host("label")[:n0]
-        w_host = self.objective.host("weights")
-        w = (w_host[:n0] if w_host is not None else np.ones_like(label))
-        if hasattr(self.objective, "label_weight") and \
-                self.objective.name == "mape":
-            w = self.objective.host("label_weight")[:n0]
-        scores_np = np.array(prev_scores)
-        leaf_ids_np = np.asarray(leaf_ids)
-        mask = np.asarray(sample_mask)[:n0] > 0
-        k = self.num_tree_per_iteration
-        from ..objectives import _weighted_percentile
-        for c in range(k):
-            ht = host_trees[c]
-            resid = label - scores_np[:n0, c]
-            li = leaf_ids_np[c][:n0]
-            for leaf in range(ht.num_leaves_actual):
-                sel = (li == leaf) & mask
-                if sel.any():
-                    ht.leaf_value[leaf] = _weighted_percentile(
-                        resid[sel], w[sel], alpha)
-            # rebuild score delta with renewed (pre-shrinkage) values; the
-            # shrinkage is applied when the tree is stored
-            scores_np[:, c] += ht.leaf_value[leaf_ids_np[c]] * self.shrinkage_rate
-        return jnp.asarray(scores_np)
 
     def _extract_host_tree(self, t) -> HostTree:
         """TreeArrays (device) -> HostTree with real thresholds."""

@@ -155,12 +155,14 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # ---- device (config.h:770-790); gpu_* accepted for compat, unused on TPU ----
     ("gpu_platform_id", int, -1, []),
     ("gpu_device_id", int, -1, []),
-    ("gpu_use_dp", bool, False, []),          # true -> pallas_highest kernel
+    ("gpu_use_dp", bool, False, []),          # true -> f64 histogram accum
+    #   (reference double-precision histograms, config.h:784; enables jax
+    #   x64 mode — ~2x memory, slower on TPU, tightest reference parity)
     # ---- TPU-specific extensions (no reference counterpart) ----
     ("tpu_hist_dtype", str, "float32", []),   # histogram accumulation dtype
     # histogram kernel: auto (pallas on TPU, scatter on CPU) | pallas |
-    # pallas_highest (full-f32 MXU contraction, ~2x cost, tightest parity —
-    # also selected by gpu_use_dp=true) | matmul | scatter | pallas_interpret
+    # pallas_highest (full-f32 MXU contraction, ~2x cost) | matmul |
+    # scatter | pallas_interpret; f64 mode routes off the f32-only pallas
     # — the GPUTreeLearner device-path dispatch analog (tree_learner.cpp:9-31)
     ("tpu_hist_impl", str, "auto", []),
     ("tpu_donate_buffers", bool, True, []),   # donate score/state buffers under jit

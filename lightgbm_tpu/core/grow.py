@@ -53,6 +53,10 @@ class GrowParams(NamedTuple):
     split: SplitParams
     row_chunk: int = 16384
     hist_impl: str = "matmul"
+    # histogram accumulation dtype: "f32" (default) or "f64" (gpu_use_dp,
+    # config.h:784 — the reference's double-precision histograms; needs
+    # jax_enable_x64, enforced by the GBDT driver)
+    hist_dtype: str = "f32"
     # PV-Tree voting-parallel (voting_parallel_tree_learner.cpp): each device
     # votes its local top_k features; only the elected <=2*top_k candidates'
     # histograms are globally reduced. 0 = disabled (full reduction).
@@ -146,7 +150,7 @@ class TreeArrays(NamedTuple):
         return self.leaf_value.shape[0]
 
 
-def empty_tree(num_leaves: int) -> TreeArrays:
+def empty_tree(num_leaves: int, dtype=jnp.float32) -> TreeArrays:
     l = num_leaves
     return TreeArrays(
         split_feature=jnp.zeros((l - 1,), jnp.int32),
@@ -157,14 +161,14 @@ def empty_tree(num_leaves: int) -> TreeArrays:
         cat_bitset=jnp.zeros((l - 1, 8), jnp.uint32),
         left_child=jnp.full((l - 1,), -1, jnp.int32),
         right_child=jnp.full((l - 1,), -1, jnp.int32),
-        split_gain=jnp.zeros((l - 1,), jnp.float32),
-        internal_value=jnp.zeros((l - 1,), jnp.float32),
-        internal_weight=jnp.zeros((l - 1,), jnp.float32),
-        internal_count=jnp.zeros((l - 1,), jnp.float32),
+        split_gain=jnp.zeros((l - 1,), dtype),
+        internal_value=jnp.zeros((l - 1,), dtype),
+        internal_weight=jnp.zeros((l - 1,), dtype),
+        internal_count=jnp.zeros((l - 1,), dtype),
         split_leaf=jnp.full((l - 1,), -1, jnp.int32),
-        leaf_value=jnp.zeros((l,), jnp.float32),
-        leaf_weight=jnp.zeros((l,), jnp.float32),
-        leaf_count=jnp.zeros((l,), jnp.float32),
+        leaf_value=jnp.zeros((l,), dtype),
+        leaf_weight=jnp.zeros((l,), dtype),
+        leaf_count=jnp.zeros((l,), dtype),
         leaf_parent=jnp.full((l,), -1, jnp.int32),
         leaf_depth=jnp.zeros((l,), jnp.int32),
         num_leaves=jnp.asarray(1, jnp.int32),
@@ -217,11 +221,11 @@ class _GrowState(NamedTuple):
     pool_map: Optional[PoolMap]   # LRU slot map (None = uncapped)
 
 
-def _empty_best(num_leaves: int) -> BestSplit:
+def _empty_best(num_leaves: int, dtype=jnp.float32) -> BestSplit:
     l = num_leaves
-    f32 = lambda: jnp.zeros((l,), jnp.float32)
+    f32 = lambda: jnp.zeros((l,), dtype)
     return BestSplit(
-        gain=jnp.full((l,), K_MIN_SCORE, jnp.float32),
+        gain=jnp.full((l,), K_MIN_SCORE, dtype),
         feature=jnp.zeros((l,), jnp.int32),
         threshold=jnp.zeros((l,), jnp.int32),
         default_left=jnp.zeros((l,), bool),
@@ -419,11 +423,16 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     b = params.num_bins                 # column-histogram bin axis
     bf = params.num_feat_bins or b      # per-feature bin axis (split search)
     sp = params.split
+    # histogram accumulation dtype (f64 = reference gpu_use_dp semantics)
+    hdt = jnp.float64 if params.hist_dtype == "f64" else jnp.float32
 
     fp_mode = fp is not None and axis_name is not None
     # self-enforcing invariant (not just the GBDT gate): fp mode has no
     # expand/global-histogram machinery for forced splits, CEGB penalties,
     # or voting — silently dropping them would build wrong trees
+    assert not fp_mode or params.hist_dtype == "f32", \
+        "f64 histograms are not supported on the explicit feature-parallel " \
+        "learner (sync_best_split bitcasts f32; use the GSPMD fallback)"
     assert not fp_mode or (forced is None and cegb is None
                            and params.num_forced == 0
                            and params.voting_top_k == 0), \
@@ -540,14 +549,16 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     best_for = voting_best if voting else full_best
 
     # ---- root ------------------------------------------------------------
-    sample_mask = sample_mask.astype(jnp.float32)
+    sample_mask = sample_mask.astype(hdt)
+    grad = grad.astype(hdt)
+    hess = hess.astype(hdt)
     vals3 = stack_vals(grad, hess, sample_mask) if use_partition else None
     root_g = psum(jnp.sum(grad * sample_mask))
     root_h = psum(jnp.sum(hess * sample_mask))
     root_c = psum(jnp.sum(sample_mask))
     hist_root = hist_for_mask(sample_mask)
 
-    tree = empty_tree(l)
+    tree = empty_tree(l, hdt)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(
             calculate_leaf_output(root_g, root_h, sp.lambda_l1, sp.lambda_l2,
@@ -558,7 +569,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     root_pen = cegb_gain_penalty(cegb, root_c, sample_mask)
     best0 = best_for(hist_root, root_g, root_h, root_c, True,
                      gain_penalty=root_pen)  # root: depth 0
-    best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
+    best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l, hdt),
+                        best0)
 
     capped = (0 < params.pool_slots < l) and not use_partition
     assert not (capped and axis_name is not None), \
@@ -575,7 +587,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # children directly, so there is no parent to subtract from, and forced
     # splits rebuild any leaf's histogram from its rows
     num_slots = 1 if use_partition else (params.pool_slots if capped else l)
-    hist_pool = jnp.zeros((num_slots, ncols_h, b, 3), jnp.float32)
+    hist_pool = jnp.zeros((num_slots, ncols_h, b, 3), hdt)
     if voting:
         # the pool holds LOCAL histograms in voting mode -> device-varying
         hist_pool = lax.pcast(hist_pool, (axis_name,), to="varying")
@@ -601,7 +613,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 lambda _: hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
                                         params.row_chunk, valid=True,
                                         impl=params.hist_impl),
-                lambda _: jnp.zeros((ncols_h, b, 3), jnp.float32),
+                lambda _: jnp.zeros((ncols_h, b, 3), hdt),
                 operand=None)
         if not capped:
             return s.hist_pool[leaf_idx]
@@ -611,7 +623,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             return s.hist_pool[jnp.maximum(sl, 0)]
 
         def rebuild(_):
-            m = (s.leaf_id == leaf_idx).astype(jnp.float32) * sample_mask
+            m = (s.leaf_id == leaf_idx).astype(hdt) * sample_mask
             return hist_for_mask(m)
 
         # dead iterations (live=False) never pay for a rebuild
@@ -630,8 +642,8 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             lambda a: lax.pcast(a, (axis_name,), to="varying"), part0)
     state = _GrowState(leaf_id=leaf_id0, hist_pool=hist_pool,
                        best=best, tree=tree,
-                       leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
-                       leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
+                       leaf_min=jnp.full((l,), -jnp.inf, hdt),
+                       leaf_max=jnp.full((l,), jnp.inf, hdt),
                        part=part0, cegb=cegb,
                        force_aborted=jnp.asarray(False),
                        pool_map=pool_map0)
@@ -826,20 +838,20 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             hist_small = jnp.where(left_smaller, hist_left_d, hist_right_d)
         elif axis_name is None:
             def live_hist(_):
-                m = (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
+                m = (leaf_id == small_leaf).astype(hdt) * sample_mask
                 return hist_for_mask(m)
 
             # skip dead iterations entirely (tree stopped growing early)
             hist_small = lax.cond(valid, live_hist,
                                   lambda _: jnp.zeros((ncols_h, b, 3),
-                                                      jnp.float32),
+                                                      hdt),
                                   operand=None)
         else:
             # collectives can't sit under a cond branch in SPMD code; a dead
             # iteration just psums zeros
             hist_small = hist_for_mask(
-                (leaf_id == small_leaf).astype(jnp.float32) * sample_mask
-                * valid.astype(jnp.float32))
+                (leaf_id == small_leaf).astype(hdt) * sample_mask
+                * valid.astype(hdt))
         if use_partition:
             # no subtraction, no pool: the sibling was priced in the same
             # fused pass
@@ -962,7 +974,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             return bl, br
 
         def dead_bests(_):
-            dead = jax.tree.map(lambda a: a[0], _empty_best(1))
+            dead = jax.tree.map(lambda a: a[0], _empty_best(1, hdt))
             return dead, dead
 
         if voting or fp_mode:
@@ -988,4 +1000,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     leaf_id_out = state.leaf_id
     if use_partition and not maintain_lid:
         leaf_id_out = leaf_id_from_partition(state.part, n, l)
-    return state.tree, leaf_id_out, state.cegb
+    # the model contract is f32 tree arrays regardless of the histogram
+    # accumulation dtype (the reference also stores float leaf values)
+    tree_out = jax.tree.map(
+        lambda a: a.astype(jnp.float32) if a.dtype == jnp.float64 else a,
+        state.tree)
+    return tree_out, leaf_id_out, state.cegb

@@ -10,8 +10,10 @@ so the contraction runs on the MXU:
 
 chunked over rows with ``lax.scan`` so the transient one-hot tile stays small.
 A scatter-add (segment-sum) variant is kept for CPU meshes where XLA scatter
-is fast. Accumulation is float32, like the GPU learner's single-precision
-histograms (gpu_tree_learner.h:74-78) — validated to the same AUC tolerance.
+is fast. Accumulation follows the value dtype: float32 by default, like the
+GPU learner's single-precision histograms (gpu_tree_learner.h:74-78), or
+float64 when gpu_use_dp / tpu_hist_dtype=float64 casts the stacked values
+(the reference's double-precision histograms, config.h:784).
 
 The entry ``build_histogram`` returns ``[F, B, 3]`` with channels
 (sum_grad, sum_hess, count), the HistogramBinEntry layout (bin.h:29-57) as a
@@ -36,9 +38,9 @@ def _hist_chunk_matmul(xb_chunk: jnp.ndarray, vals_chunk: jnp.ndarray,
     c, f = xb_chunk.shape
     onehot = (xb_chunk[:, :, None] == jnp.arange(num_bins, dtype=xb_chunk.dtype)
               ).astype(vals_chunk.dtype)  # [C, F, B]
-    # contract over rows: [F*B, C] @ [C, 3]. HIGHEST keeps f32 accumulation on
-    # the MXU (TPU matmuls default to bf16 inputs, which breaks the 1e-4 AUC
-    # parity budget — the analog of gpu_use_dp, config.h:784).
+    # contract over rows: [F*B, C] @ [C, 3]. HIGHEST keeps full-precision
+    # accumulation in the value dtype on the MXU (TPU matmuls default to
+    # bf16 inputs, which breaks the 1e-4 AUC parity budget).
     return lax.dot_general(onehot, vals_chunk,
                            (((0,), (0,)), ((), ())),
                            precision=lax.Precision.HIGHEST)  # [F, B, 3]
@@ -112,7 +114,7 @@ def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         xbc, vc = chunk
         return acc + _hist_chunk_matmul(xbc, vc, num_bins), None
 
-    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
+    init = jnp.zeros((f, num_bins, 3), dtype=vals.dtype)
     hist, _ = lax.scan(step, init, (xb_c, vals_c))
     return hist
 

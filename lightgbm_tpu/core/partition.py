@@ -69,7 +69,7 @@ def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
     """[N, 3] (grad*mask, hess*mask, mask) — one gather per histogram trip
     instead of three (the ordered-gradients copy of the reference,
     dataset.cpp ConstructHistograms)."""
-    m = mask.astype(jnp.float32)
+    m = mask.astype(grad.dtype)
     return jnp.stack([grad * m, hess * m, m], axis=1)
 
 
@@ -141,12 +141,12 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
         idx_safe = jnp.minimum(idx, n_rows - 1)
         rows = xb.at[idx_safe].get(mode="promise_in_bounds")   # [chunk, F]
         v = vals.at[idx_safe].get(mode="promise_in_bounds") \
-            * in_range[:, None].astype(jnp.float32)            # [chunk, 3]
+            * in_range[:, None].astype(vals.dtype)             # [chunk, 3]
         go_left = go_left_from_rows(rows)
         is_l = go_left & in_range
         is_r = (~go_left) & in_range
-        v6 = jnp.concatenate([v * is_l[:, None].astype(jnp.float32),
-                              v * is_r[:, None].astype(jnp.float32)],
+        v6 = jnp.concatenate([v * is_l[:, None].astype(vals.dtype),
+                              v * is_r[:, None].astype(vals.dtype)],
                              axis=1)                           # [chunk, 6]
         hist = hist_tile_vals(rows, v6, num_bins, impl)
         return idx, idx_safe, go_left, is_l, is_r, hist
@@ -172,10 +172,10 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
         acc = acc + hist
         # in_range is a prefix mask, so within range the right-side running
         # count is (position + 1) - left count: one cumsum covers both
-        cl = jnp.cumsum(is_l.astype(jnp.int32))
+        cl = jnp.cumsum(is_l.astype(jnp.int32), dtype=jnp.int32)
         cr = (j + 1) - cl
         kl = cl[-1]
-        kr = jnp.sum(in_range.astype(jnp.int32)) - kl
+        kr = jnp.sum(in_range.astype(jnp.int32), dtype=jnp.int32) - kl
         lpos = beg + nl + (cl - is_l)
         rpos = beg + cnt - 1 - nr - (cr - is_r)
         pos = jnp.where(go_left, lpos, rpos)
@@ -186,7 +186,7 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
 
     def multi_trip(_):
         init = (jnp.int32(0), jnp.int32(0), jnp.int32(0), part.order,
-                leaf_id, jnp.zeros((f, num_bins, 6), jnp.float32))
+                leaf_id, jnp.zeros((f, num_bins, 6), vals.dtype))
         _, nl, nr, order_new, lid, acc = lax.while_loop(cond, body, init)
         return order_new, lid, nl, nr, acc
 
@@ -211,12 +211,13 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
             _, sidx = lax.sort((key, idx), num_keys=1, is_stable=True)
             order_new = lax.dynamic_update_slice(part.order, sidx, (beg,))
             lid = maybe_lid(leaf_id, idx_safe, is_r)
-            return (order_new, lid, jnp.sum(is_l.astype(jnp.int32)),
-                    jnp.sum(is_r.astype(jnp.int32)), acc)
+            return (order_new, lid,
+                    jnp.sum(is_l.astype(jnp.int32), dtype=jnp.int32),
+                    jnp.sum(is_r.astype(jnp.int32), dtype=jnp.int32), acc)
 
         def dead(_):
             return (part.order, leaf_id, jnp.int32(0), jnp.int32(0),
-                    jnp.zeros((f, num_bins, 6), jnp.float32))
+                    jnp.zeros((f, num_bins, 6), vals.dtype))
 
         which = jnp.where(cnt == 0, 0, jnp.where(cnt <= chunk, 1, 2))
         order_new, leaf_id, n_left, n_right, acc6 = lax.switch(
@@ -259,11 +260,11 @@ def hist_for_leaf(part: RowPartition, leaf, xb: jnp.ndarray,
         idx_safe = jnp.minimum(jnp.where(in_range, idx, 0), n_rows - 1)
         rows = xb.at[idx_safe].get(mode="promise_in_bounds")   # [chunk, F]
         v = vals.at[idx_safe].get(mode="promise_in_bounds") \
-            * in_range[:, None].astype(jnp.float32)            # [chunk, 3]
+            * in_range[:, None].astype(vals.dtype)             # [chunk, 3]
         return i + 1, acc + hist_tile_vals(rows, v, num_bins, impl)
 
     _, hist = lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.zeros((f, num_bins, 3), jnp.float32)))
+        cond, body, (jnp.int32(0), jnp.zeros((f, num_bins, 3), vals.dtype)))
     return hist
 
 

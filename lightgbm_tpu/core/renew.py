@@ -1,0 +1,67 @@
+"""Device-side RenewTreeOutput: per-leaf weighted-percentile leaf refit.
+
+The reference refits L1/Quantile/MAPE leaf outputs after growth by walking
+each leaf's rows on the host (SerialTreeLearner::RenewTreeOutput,
+src/treelearner/serial_tree_learner.cpp:850-928, calling the objective's
+percentile functions, src/objective/regression_objective.hpp:20-75, with a
+distributed GlobalSumReducer in the parallel learners). Host loops don't
+exist on a TPU step, so the same math runs in-graph as ONE segmented
+weighted-percentile over all leaves at once:
+
+- rows are sorted once by (leaf, residual) — a [N] `lax.sort` instead of
+  per-leaf gathers;
+- each leaf's weighted CDF is a slice of one global `cumsum`;
+- the percentile index is a vectorized `searchsorted` of every leaf's
+  target into the global CDF, clipped to the leaf's segment.
+
+Semantics match the host `_weighted_percentile` (objectives.py): the
+returned value is the first sorted residual whose cumulative weight
+reaches ``alpha * total`` — the documented lower-percentile simplification
+of the reference's interpolating PercentileFun (the golden endpoint tests
+in test_parity_tasks.py pin that this stays within reference tolerance).
+
+Under a data-parallel mesh this code runs at the jit level (outside the
+explicit shard_map learners), so XLA partitions the sort/cumsum globally —
+the GlobalSum moment of the reference's distributed renew.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def renew_leaf_values(resid: jnp.ndarray, weight: jnp.ndarray,
+                      leaf_id: jnp.ndarray, mask: jnp.ndarray,
+                      num_leaves: int,
+                      alpha: float,
+                      orig_leaf_value: jnp.ndarray) -> jnp.ndarray:
+    """[L] renewed leaf values: weighted alpha-percentile of ``resid`` over
+    each leaf's masked rows; leaves with no rows keep ``orig_leaf_value``.
+
+    resid/weight [N] float; leaf_id [N] int32; mask [N] (bool or float —
+    nonzero = row participates, the bagging_mapper analog).
+    """
+    n = resid.shape[0]
+    active = mask > 0 if mask.dtype != jnp.bool_ else mask
+    # masked-out rows sort past every real leaf segment
+    lid = jnp.where(active, leaf_id, num_leaves).astype(jnp.int32)
+    w_eff = jnp.where(active, weight, 0.0).astype(resid.dtype)
+    srt_lid, srt_resid, srt_w = lax.sort(
+        (lid, resid, w_eff), num_keys=2)
+    cw = jnp.cumsum(srt_w)
+    counts = jnp.zeros((num_leaves + 1,), jnp.int32).at[lid].add(
+        1, mode="promise_in_bounds")
+    cnt = counts[:num_leaves]
+    begin = (jnp.cumsum(counts, dtype=jnp.int32) - counts)[:num_leaves]
+    end = begin + cnt                                   # exclusive
+    zero = jnp.zeros((), cw.dtype)
+    seg_lo = jnp.where(begin > 0, cw[jnp.maximum(begin - 1, 0)], zero)
+    seg_hi = jnp.where(end > 0, cw[jnp.maximum(end - 1, 0)], zero)
+    # host analog: idx = searchsorted(cum_seg, alpha * total, 'left');
+    # the global CDF is the segment CDF shifted by seg_lo, so one
+    # vectorized searchsorted serves every leaf
+    target = seg_lo + alpha * (seg_hi - seg_lo)
+    pos = jnp.searchsorted(cw, target, side="left").astype(jnp.int32)
+    pos = jnp.clip(pos, begin, jnp.maximum(end - 1, begin))
+    val = srt_resid[jnp.clip(pos, 0, n - 1)]
+    return jnp.where(cnt > 0, val, orig_leaf_value)
