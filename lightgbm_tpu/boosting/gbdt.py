@@ -372,15 +372,14 @@ class GBDT:
 
         # explicit shard_map data-parallel learner: every device partitions
         # its local row shard and only child histograms cross the mesh
-        # (data_parallel_tree_learner.cpp:146-161). Forced splits and CEGB
-        # keep the masked GSPMD path (their cond-guarded rebuilds / row
-        # accounting cannot sit on the sharded partition).
+        # (data_parallel_tree_learner.cpp:146-161). Forced splits rebuild
+        # leaf histograms straight-line + psum (grow.py leaf_hist), and
+        # CEGB state threads through the shard_map with row_used sharded —
+        # neither drops this learner to the masked fallback anymore.
         self._partition_on_mesh = (
             self.mesh is not None
             and cfg.tree_learner == "data"
-            and mesh_mod.DATA_AXIS in self.mesh.axis_names
-            and num_forced == 0
-            and self._cegb_state is None)
+            and mesh_mod.DATA_AXIS in self.mesh.axis_names)
 
         self.grow_params = GrowParams(
             num_leaves=cfg.num_leaves,
@@ -808,6 +807,8 @@ class GBDT:
                 from ..parallel.mesh import DATA_AXIS
                 tree_spec = jax.tree.map(lambda _: P(),
                                          empty_tree(params.num_leaves))
+                has_cegb = self._cegb_state is not None \
+                    and params.voting_top_k == 0
                 if params.batch_splits > 0:
                     from ..core.grow_batched import grow_tree_batched
 
@@ -815,20 +816,47 @@ class GBDT:
                         return grow_tree_batched(
                             xbj, gj, hj, mj, meta, fm, params,
                             axis_name=DATA_AXIS)[:2]
+                elif has_cegb:
+                    from ..core.grow import CegbState
+
+                    def _grow_core_cegb(xbj, gj, hj, mj, fm, cs):
+                        return grow_tree(xbj, gj, hj, mj, meta, fm, params,
+                                         axis_name=DATA_AXIS,
+                                         forced=forced_splits, cegb=cs)
+                    # acquisition state: per-feature fields replicated,
+                    # lazy per-row accounting sharded with the rows
+                    cegb_specs = CegbState(
+                        coupled_penalty=P(), lazy_penalty=P(),
+                        feature_used=P(), row_used=P(None, DATA_AXIS))
+                    grow_cegb = jax.shard_map(
+                        _grow_core_cegb,
+                        mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
+                                             P(DATA_AXIS), P(DATA_AXIS),
+                                             P(), cegb_specs),
+                        out_specs=(tree_spec, P(DATA_AXIS), cegb_specs),
+                        check_vma=False)
+
+                    def grow_one(gk, hk, cs):
+                        return grow_cegb(xb, gk, hk, sample_mask,
+                                         feature_mask, cs)
                 else:
                     def _grow_core(xbj, gj, hj, mj, fm):
                         return grow_tree(xbj, gj, hj, mj, meta, fm, params,
-                                         axis_name=DATA_AXIS)[:2]
-                grow_sharded = jax.shard_map(
-                    _grow_core,
-                    mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
-                                         P(DATA_AXIS), P(DATA_AXIS), P()),
-                    out_specs=(tree_spec, P(DATA_AXIS)), check_vma=False)
+                                         axis_name=DATA_AXIS,
+                                         forced=forced_splits)[:2]
+                if not has_cegb:
+                    grow_sharded = jax.shard_map(
+                        _grow_core,
+                        mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
+                                             P(DATA_AXIS), P(DATA_AXIS),
+                                             P()),
+                        out_specs=(tree_spec, P(DATA_AXIS)),
+                        check_vma=False)
 
-                def grow_one(gk, hk, cs):
-                    t, li = grow_sharded(xb, gk, hk, sample_mask,
-                                         feature_mask)
-                    return t, li, None
+                    def grow_one(gk, hk, cs):
+                        t, li = grow_sharded(xb, gk, hk, sample_mask,
+                                             feature_mask)
+                        return t, li, None
             elif params.batch_splits > 0:
                 from ..core.grow_batched import grow_tree_batched
 

@@ -579,10 +579,6 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     assert not capped or params.pool_slots >= 2, \
         "a capped histogram pool needs at least 2 slots (both children " \
         "of a split are resident)"
-    assert not (use_partition and axis_name is not None
-                and params.num_forced > 0), \
-        "forced splits need a leaf-histogram rebuild under lax.cond, which " \
-        "cannot psum on the sharded partition path (use the masked learner)"
     # the partition path needs no pool at all: the fused pass prices both
     # children directly, so there is no parent to subtract from, and forced
     # splits rebuild any leaf's histogram from its rows
@@ -606,8 +602,17 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         run BEFORE the step's partition update — the rebuild walks the
         pre-split row partition / leaf_id."""
         if use_partition:
-            # no pool in partition mode (only forced splits land here);
-            # dead iterations never pay for a rebuild
+            # no pool in partition mode (only forced splits land here)
+            if axis_name is not None:
+                # collectives cannot sit under lax.cond in SPMD code: the
+                # rebuild runs straight-line (valid=live zeroes the trip
+                # count on dead iterations, so they rebuild 0 rows and
+                # psum zeros) — this is what lets forced splits ride the
+                # fused sharded partition path at all
+                return psum(hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
+                                          params.row_chunk, valid=live,
+                                          impl=params.hist_impl))
+            # single device: dead iterations never pay for a rebuild
             return lax.cond(
                 live,
                 lambda _: hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
@@ -697,13 +702,18 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             cat_bitset=jnp.zeros((8,), jnp.uint32))
         return fleaf, bs, ok
 
-    def step(t: jnp.ndarray, s: _GrowState) -> _GrowState:
+    def step(t: jnp.ndarray, s: _GrowState,
+             with_forced: bool = False) -> _GrowState:
         tree = s.tree
         leaf = jnp.argmax(s.best.gain).astype(jnp.int32)
         cur = jax.tree.map(lambda a: a[leaf], s.best)
         force_aborted = s.force_aborted
-        if params.num_forced > 0 and forced is not None:
-            in_phase = (t < params.num_forced) & ~s.force_aborted
+        if with_forced:
+            # only traced into the first num_forced loop steps (the loop is
+            # split at the static phase boundary below), so steps past the
+            # forced phase never pay the evaluation or its sharded-rebuild
+            # psum; the dynamic mask only covers mid-phase aborts
+            in_phase = ~s.force_aborted
             fleaf, fcur, fok = forced_split_info(s, t, in_phase)
             use_forced = in_phase & fok
             force_aborted = s.force_aborted | (in_phase & ~fok)
@@ -977,11 +987,13 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             dead = jax.tree.map(lambda a: a[0], _empty_best(1, hdt))
             return dead, dead
 
-        if voting or fp_mode:
-            # voting_best / sync_best_split hold collectives (all_gather /
-            # psum) — they cannot sit under a cond branch; dead iterations
-            # just reduce over zeros and are discarded by the masked
-            # best-update below
+        if voting or fp_mode or (axis_name is not None
+                                 and cegb_state is not None
+                                 and params.with_cegb_lazy):
+            # voting_best / sync_best_split / the lazy-CEGB unpaid-rows
+            # psum hold collectives — they cannot sit under a cond branch;
+            # dead iterations just reduce over zeros and are discarded by
+            # the masked best-update below
             bl, br = child_bests(None)
         else:
             bl, br = lax.cond(valid, child_bests, dead_bests, operand=None)
@@ -996,7 +1008,13 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           cegb=cegb_state, force_aborted=force_aborted,
                           pool_map=pool_map)
 
-    state = lax.fori_loop(0, l - 1, step, state)
+    if params.num_forced > 0 and forced is not None:
+        nf = min(params.num_forced, l - 1)
+        state = lax.fori_loop(
+            0, nf, functools.partial(step, with_forced=True), state)
+        state = lax.fori_loop(nf, l - 1, step, state)
+    else:
+        state = lax.fori_loop(0, l - 1, step, state)
     leaf_id_out = state.leaf_id
     if use_partition and not maintain_lid:
         leaf_id_out = leaf_id_from_partition(state.part, n, l)

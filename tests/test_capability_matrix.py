@@ -50,10 +50,10 @@ MATRIX = [
      dict(part_mesh=True, use_part=True, fp=False)),
     ("data-forced", {"tree_learner": "data", "mesh_shape": [8],
                      "FORCED": True},
-     dict(part_mesh=False, use_part=False)),     # masked GSPMD, flagged
+     dict(part_mesh=True, use_part=True)),   # straight-line psum rebuild
     ("data-cegb", {"tree_learner": "data", "mesh_shape": [8],
                    "cegb_tradeoff": 0.5, "cegb_penalty_split": 1e-4},
-     dict(part_mesh=False, use_part=False)),
+     dict(part_mesh=True, use_part=True)),   # CEGB rides the shard_map
     ("data-batched", {"tree_learner": "data", "mesh_shape": [8],
                       "tree_growth": "batched"},
      dict(part_mesh=True, batch=True)),

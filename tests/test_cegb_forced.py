@@ -117,3 +117,45 @@ def test_cegb_lazy_prefers_paid_rows(xy):
                       "verbosity": -1},
                      lgb.Dataset(X, label=y), num_boost_round=3)
     assert len(_used_features(bst)) <= len(_used_features(free))
+
+
+def test_forced_splits_match_on_data_parallel_mesh(tmp_path, xy):
+    """Forced splits now ride the fused sharded partition path (the leaf
+    rebuild runs straight-line + psum, grow.py leaf_hist): an 8-shard
+    data-parallel run must reproduce the serial forced-split model."""
+    X, y = xy
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as f:
+        json.dump({"feature": 2, "threshold": 0.1,
+                   "left": {"feature": 3, "threshold": -0.2}}, f)
+    kw = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5, "forcedsplits_filename": path}
+    serial = lgb.train(dict(kw), lgb.Dataset(X, label=y), num_boost_round=4)
+    dp = lgb.train(dict(kw, tree_learner="data", mesh_shape=[8]),
+                   lgb.Dataset(X, label=y), num_boost_round=4)
+    assert dp._impl._partition_on_mesh       # not the masked fallback
+    ps = serial.predict(X[:300], raw_score=True)
+    pd = dp.predict(X[:300], raw_score=True)
+    np.testing.assert_allclose(ps, pd, rtol=1e-5, atol=1e-5)
+    # the forced structure is present in both
+    t0s = serial._impl.models[0]
+    t0d = dp._impl.models[0]
+    assert t0s.split_feature[0] == t0d.split_feature[0] == 2
+
+
+def test_cegb_lazy_matches_on_data_parallel_mesh(xy):
+    """Lazy CEGB's unpaid-row psum runs straight-line (no cond) on the
+    sharded partition path; acquisition state threads through the
+    shard_map with row_used sharded. 8-shard result == serial result."""
+    X, y = xy
+    kw = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5, "cegb_tradeoff": 0.5,
+          "cegb_penalty_split": 1e-5,
+          "cegb_penalty_feature_lazy": [0.001] * 5}
+    serial = lgb.train(dict(kw), lgb.Dataset(X, label=y), num_boost_round=4)
+    dp = lgb.train(dict(kw, tree_learner="data", mesh_shape=[8]),
+                   lgb.Dataset(X, label=y), num_boost_round=4)
+    assert dp._impl._partition_on_mesh
+    ps = serial.predict(X[:300], raw_score=True)
+    pd = dp.predict(X[:300], raw_score=True)
+    np.testing.assert_allclose(ps, pd, rtol=1e-5, atol=1e-5)

@@ -88,14 +88,14 @@ def test_data_parallel_uses_sharded_partition():
     np.testing.assert_allclose(serial.predict(X[:200], raw_score=True),
                                dp.predict(X[:200], raw_score=True),
                                rtol=1e-3, atol=1e-3)
-    # CEGB configs must drop back too — even when cegb_tradeoff is 0 a
-    # positive cegb_penalty_split creates live CEGB state (regression: the
-    # old gate multiplied the two and let state reach the partition path)
+    # CEGB and forced-split configs STAY on the fused partition path now:
+    # the forced rebuild runs straight-line + psum and CEGB state threads
+    # through the shard_map (equivalence vs serial is pinned in
+    # test_cegb_forced.py::test_*_match*_on_data_parallel_mesh)
     dp3 = _train({"objective": "binary", "tree_learner": "data",
                   "cegb_tradeoff": 0.0, "cegb_penalty_split": 5.0,
                   "verbosity": -1}, X, y, rounds=2)
-    assert not dp3._partition_on_mesh
-    # forced-split configs must drop back to the masked GSPMD learner
+    assert dp3._partition_on_mesh
     import json, tempfile, os
     fs = {"feature": 0, "threshold": float(np.median(X[:, 0]))}
     with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
@@ -105,7 +105,7 @@ def test_data_parallel_uses_sharded_partition():
         dp2 = _train({"objective": "binary", "tree_learner": "data",
                       "forcedsplits_filename": path, "verbosity": -1},
                      X, y, rounds=2)
-        assert not dp2._partition_on_mesh
+        assert dp2._partition_on_mesh
     finally:
         os.unlink(path)
 
