@@ -809,6 +809,12 @@ class GBDT:
                                          empty_tree(params.num_leaves))
                 has_cegb = self._cegb_state is not None \
                     and params.voting_top_k == 0
+                # grow_one's definedness below depends on this invariant
+                # (enforced at config time, gbdt batched gating): keep it
+                # local so relaxing that check can't unbind grow_one
+                assert not (has_cegb and params.batch_splits > 0), \
+                    "batched growth cannot carry CEGB state"
+
                 if params.batch_splits > 0:
                     from ..core.grow_batched import grow_tree_batched
 

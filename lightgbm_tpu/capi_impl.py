@@ -294,6 +294,16 @@ def booster_feature_importance(bst: Booster, num_iteration: int,
     return np.asarray(imp, np.float64).tobytes()
 
 
+def network_init_with_functions(num_machines: int, rank: int,
+                                reduce_scatter_ptr: int,
+                                allgather_ptr: int) -> None:
+    """LGBM_NetworkInitWithFunctions (c_api.h:958): register caller-
+    provided collective function pointers as the host-side transport."""
+    from .parallel import network
+    network.init_with_functions(num_machines, rank,
+                                reduce_scatter_ptr, allgather_ptr)
+
+
 def network_init(machines: str, local_listen_port: int, listen_time_out: int,
                  num_machines: int) -> None:
     from .parallel import network

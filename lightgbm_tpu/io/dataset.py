@@ -403,7 +403,7 @@ class BinnedDataset:
         return ds
 
     @classmethod
-    def from_sharded(cls, local_data, config: Config, comm,
+    def from_sharded(cls, local_data, config: Config, comm=None,
                      label: Optional[Sequence[float]] = None,
                      weight: Optional[Sequence[float]] = None,
                      init_score: Optional[Sequence[float]] = None,
@@ -429,6 +429,13 @@ class BinnedDataset:
         local_data = np.asarray(local_data)
         check(local_data.ndim == 2, "local shard must be 2-D")
         n_local, f = local_data.shape
+        if comm is None:
+            from ..parallel import network as _net
+            comm = _net.active_comm()
+            if comm is None:
+                raise LightGBMError(
+                    "from_sharded needs a comm (or a transport registered "
+                    "via LGBM_NetworkInitWithFunctions)")
         sizes = comm.allgather(n_local)
         total_n = int(sum(sizes))
 

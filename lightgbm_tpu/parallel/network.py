@@ -53,10 +53,13 @@ def init(machines: str = "", local_listen_port: int = 12400,
 
 
 def free() -> None:
-    global _initialized, _num_machines, _rank
+    global _initialized, _num_machines, _rank, _external_comm
     _initialized = False
     _num_machines = 1
     _rank = 0
+    # drop any injected transport: the host may free its callback code
+    # right after LGBM_NetworkFree
+    _external_comm = None
 
 
 def num_machines() -> int:
@@ -129,3 +132,134 @@ class LoopbackComm(HostComm):
         out = list(self._shared["slots"])
         self._shared["barrier"].wait()   # don't overwrite until all read
         return out
+
+
+class ExternalComm(HostComm):
+    """Injectable collectives — the LGBM_NetworkInitWithFunctions seam
+    (reference c_api.h:958, network.h:96, meta.h:51-57). The caller hands
+    the ABI two C function pointers:
+
+      allgather(input, input_size, block_start, block_len, num_block,
+                output, output_size)
+      reduce_scatter(input, input_size, type_size, block_start, block_len,
+                     num_block, output, output_size, &reducer)
+
+    and every host-side collective (sharded ingest's bin-sample merge,
+    HostComm.allgather users) dispatches through them instead of
+    jax.distributed — which is exactly what makes the distributed code
+    path drivable from a test without a cluster. Ragged payloads ride the
+    same two-phase shape as the reference's BinMapper sync: one fixed
+    8-byte length round, then the data round.
+    """
+
+    def __init__(self, num_machines: int, my_rank: int,
+                 reduce_scatter_ptr: int, allgather_ptr: int):
+        import ctypes
+        self._k = int(num_machines)
+        self._rank = int(my_rank)
+        c = ctypes
+        self._AGT = c.CFUNCTYPE(
+            None, c.c_char_p, c.c_int32, c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.c_int, c.c_char_p, c.c_int32)
+        # last arg: const ReduceFunction& == pointer to the function pointer
+        self._RST = c.CFUNCTYPE(
+            None, c.c_char_p, c.c_int32, c.c_int, c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.c_int, c.c_char_p, c.c_int32,
+            c.POINTER(c.c_void_p))
+        # void* not char*: ctypes converts incoming c_char_p callback
+        # args to NUL-truncated bytes, corrupting binary payloads
+        self._REDT = c.CFUNCTYPE(None, c.c_void_p, c.c_void_p, c.c_int,
+                                 c.c_int32)
+        self._ag = self._AGT(allgather_ptr) if allgather_ptr else None
+        self._rs = self._RST(reduce_scatter_ptr) if reduce_scatter_ptr else None
+
+    def _allgather_raw(self, blob: bytes, block_lens) -> bytes:
+        import ctypes as c
+        k = self._k
+        starts = [0] * k
+        for i in range(1, k):
+            starts[i] = starts[i - 1] + int(block_lens[i - 1])
+        total = starts[-1] + int(block_lens[-1])
+        out = c.create_string_buffer(total)
+        inp = c.create_string_buffer(bytes(blob), len(blob))
+        self._ag(c.cast(inp, c.c_char_p), c.c_int32(len(blob)),
+                 (c.c_int32 * k)(*starts), (c.c_int32 * k)(
+                     *[int(b) for b in block_lens]),
+                 c.c_int(k), c.cast(out, c.c_char_p), c.c_int32(total))
+        return out.raw
+
+    def allgather(self, obj):
+        import pickle
+        import struct
+        if self._ag is None:
+            raise LightGBMError("external allgather function not provided")
+        blob = pickle.dumps(obj)
+        lens_raw = self._allgather_raw(struct.pack("<q", len(blob)),
+                                       [8] * self._k)
+        lens = [struct.unpack_from("<q", lens_raw, 8 * i)[0]
+                for i in range(self._k)]
+        data = self._allgather_raw(blob, lens)
+        out, off = [], 0
+        for ln in lens:
+            out.append(pickle.loads(data[off:off + ln]))
+            off += ln
+        return out
+
+    def reduce_scatter_sum(self, arr):
+        """Reference Network::ReduceScatter shape: each rank contributes a
+        float64 array of K equal blocks; rank r receives the element-wise
+        sum of every rank's block r. The sum reducer crosses the ABI as a
+        ReduceFunction pointer (meta.h:51)."""
+        import ctypes as c
+        import numpy as np
+        if self._rs is None:
+            raise LightGBMError("external reduce_scatter function "
+                                "not provided")
+        a = np.ascontiguousarray(arr, np.float64)
+        k = self._k
+        if a.size % k:
+            raise LightGBMError("reduce_scatter payload not divisible "
+                                "into %d blocks" % k)
+        blk = a.size // k
+        blk_bytes = blk * 8
+
+        def _sum(src, dst, type_size, nbytes):
+            n = nbytes // 8
+            s = np.frombuffer(c.string_at(src, nbytes), np.float64, n)
+            buf = (c.c_double * n).from_address(dst)
+            np.asarray(buf)[:] += s
+        reducer = self._REDT(_sum)
+        reducer_ptr = c.c_void_p(c.cast(reducer, c.c_void_p).value)
+        starts = (c.c_int32 * k)(*[i * blk_bytes for i in range(k)])
+        lens = (c.c_int32 * k)(*([blk_bytes] * k))
+        out = c.create_string_buffer(blk_bytes)
+        inp = a.tobytes()
+        inbuf = c.create_string_buffer(inp, len(inp))
+        self._rs(c.cast(inbuf, c.c_char_p), c.c_int32(len(inp)),
+                 c.c_int(8), starts, lens, c.c_int(k),
+                 c.cast(out, c.c_char_p), c.c_int32(blk_bytes),
+                 c.byref(reducer_ptr))
+        return np.frombuffer(out.raw, np.float64, blk).copy()
+
+
+_external_comm: Optional[ExternalComm] = None
+
+
+def init_with_functions(num_machines: int, rank: int,
+                        reduce_scatter_ptr: int, allgather_ptr: int) -> None:
+    """LGBM_NetworkInitWithFunctions analog: injectable collectives for
+    hosts that bring their own transport (or tests that bring none)."""
+    global _initialized, _num_machines, _rank, _external_comm
+    _external_comm = ExternalComm(num_machines, rank,
+                                  reduce_scatter_ptr, allgather_ptr)
+    _initialized = True
+    _num_machines = int(num_machines)
+    _rank = int(rank)
+    Log.info("Network init with external functions: rank %d / %d",
+             _rank, _num_machines)
+
+
+def active_comm() -> Optional[HostComm]:
+    """The registered external transport, if any — HostComm consumers
+    (e.g. BinnedDataset.from_sharded) use it when no comm is passed."""
+    return _external_comm

@@ -9,11 +9,13 @@
  * package, whose compute path runs on TPU through JAX/XLA — the C layer
  * is a marshalling shim, deliberately free of training logic.
  *
- * Functions of the reference ABI that are NOT implemented return -1 with
- * a "not supported" error (never silent): streaming row pushes
- * (LGBM_DatasetPushRows*), CSC ingestion, and network-function injection
- * (LGBM_NetworkInitWithFunctions) have no analog in this runtime, whose
- * datasets bin on device and whose collectives ride XLA/ICI.
+ * The full 64-entry reference ABI is implemented, including the
+ * callback-based CSR constructor (LGBM_DatasetCreateFromCSRFunc; the
+ * funptr is a std::function, an in-process same-toolchain contract like
+ * the reference's) and injectable collectives
+ * (LGBM_NetworkInitWithFunctions: the function pointers become the
+ * host-side HostComm transport used by sharded ingest — per-iteration
+ * training collectives remain XLA ops on ICI by design).
  */
 #ifndef LIGHTGBM_TPU_C_API_H_
 #define LIGHTGBM_TPU_C_API_H_
@@ -244,7 +246,7 @@ int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
                                double* out_result);
 void LGBM_SetLastError(const char* msg);
 
-/* ---- explicit not-supported stubs (always -1 + error message) ---- */
+/* ---- callback-based constructors / injectable collectives ---- */
 int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
                                   int64_t num_col, const char* parameters,
                                   const DatasetHandle reference,

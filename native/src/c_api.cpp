@@ -12,8 +12,10 @@
 
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "../include/lightgbm_tpu_c_api.h"
@@ -1143,24 +1145,70 @@ void LGBM_SetLastError(const char* msg) {
   g_last_error = msg ? msg : "";
 }
 
-/* Explicit not-supported surface: these reference entry points have no
- * analog in this runtime (the collective backend is XLA over ICI/DCN,
- * not injectable socket functions; callback-driven CSR iteration has no
- * useful embedding across the C/Python boundary). They fail loudly
- * instead of linking away. */
-static int not_supported(const char* what) {
-  g_last_error = std::string(what) +
-      " is not supported by lightgbm_tpu (see native/BINDINGS.md)";
-  return -1;
+/* Callback-based constructor + injectable collectives: the last two
+ * entry points of the 64-entry reference ABI. */
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const DatasetHandle reference,
+                                  DatasetHandle* out) {
+  // get_row_funptr points at a std::function (reference c_api.h:156-165)
+  // — an in-process, same-toolchain contract, exactly how the reference's
+  // SWIG wrapper uses it. Rows are pulled BEFORE entering Python so user
+  // code never runs under the GIL.
+  using RowFn = std::function<void(int, std::vector<std::pair<int, double>>&)>;
+  auto* get_row = reinterpret_cast<RowFn*>(get_row_funptr);
+  if (get_row == nullptr) {
+    g_last_error = "LGBM_DatasetCreateFromCSRFunc: null get_row_funptr";
+    return -1;
+  }
+  if (num_rows < 0) {
+    g_last_error = "LGBM_DatasetCreateFromCSRFunc: negative num_rows";
+    return -1;
+  }
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> indices;
+  std::vector<double> values;
+  indptr.reserve(static_cast<size_t>(num_rows) + 1);
+  indptr.push_back(0);
+  std::vector<std::pair<int, double>> row;
+  try {
+    for (int i = 0; i < num_rows; ++i) {
+      (*get_row)(i, row);  // callee clears and fills (c_api.h:158)
+      for (const auto& kv : row) {
+        indices.push_back(kv.first);
+        values.push_back(kv.second);
+      }
+      indptr.push_back(static_cast<int64_t>(indices.size()));
+    }
+  } catch (const std::exception& e) {
+    g_last_error = std::string("get_row callback failed: ") + e.what();
+    return -1;
+  }
+  return LGBM_DatasetCreateFromCSR(
+      indptr.data(), C_API_DTYPE_INT64, indices.data(), values.data(),
+      C_API_DTYPE_FLOAT64, static_cast<int64_t>(indptr.size()),
+      static_cast<int64_t>(values.size()), num_col, parameters, reference,
+      out);
 }
 
-int LGBM_DatasetCreateFromCSRFunc(void*, int, int64_t, const char*,
-                                  const DatasetHandle, DatasetHandle*) {
-  return not_supported("LGBM_DatasetCreateFromCSRFunc");
-}
-
-int LGBM_NetworkInitWithFunctions(int, int, void*, void*) {
-  return not_supported("LGBM_NetworkInitWithFunctions");
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun) {
+  // injectable collectives (reference network.h:96): the raw function
+  // pointers cross into Python as integers; parallel/network.py wraps
+  // them in an ExternalComm that the host-side collective seam
+  // (HostComm) dispatches through
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "network_init_with_functions",
+      Py_BuildValue("(iiLL)", num_machines, rank,
+                    static_cast<long long>(
+                        reinterpret_cast<uintptr_t>(reduce_scatter_ext_fun)),
+                    static_cast<long long>(
+                        reinterpret_cast<uintptr_t>(allgather_ext_fun))));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
 }
 
 }  // extern "C"
