@@ -106,6 +106,11 @@ def run_bench(backend_info: dict) -> dict:
     # sweep hook: BENCH_HIST_IMPL in {auto, matmul, scatter, pallas}
     if os.environ.get("BENCH_HIST_IMPL"):
         cfg_d["tpu_hist_impl"] = os.environ["BENCH_HIST_IMPL"]
+    # free-form sweep hook: BENCH_EXTRA_PARAMS="k=v k2=v2"
+    for tok in os.environ.get("BENCH_EXTRA_PARAMS", "").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            cfg_d[k] = v
     cfg = Config(cfg_d)
     ds = BinnedDataset.from_matrix(X, cfg, label=y)
     b = create_boosting(cfg, ds, create_objective(cfg), [])

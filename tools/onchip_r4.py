@@ -1,0 +1,254 @@
+"""Round-4 on-chip measurement protocol (VERDICT r3 #1) in ONE command.
+
+The axon TPU tunnel has died mid-round in every previous round, so this
+runs the full measurement list as independent subprocess steps with hard
+timeouts and APPENDS each result to ``tools/onchip_r4_results.json`` as
+soon as it lands — a tunnel death halfway through still leaves every
+completed measurement on disk.
+
+    python tools/onchip_r4.py [--quick]
+
+Steps (each skippable by prior completion, rerun with --redo):
+  probe          backend probe (device kind, cheap matmul)
+  kernel_parity  slot kernel + hist_tile_vals vs scatter ON HARDWARE
+  bench_default  bench.py as the driver runs it (batched growth)
+  bench_exact    BENCH_TREE_GROWTH=exact comparison point
+  bench_k{4,8,16,32}  batched-growth K sweep
+  bench_pack     tpu_batched_pack=true at the best K so far
+  full_shape     HIGGS-shaped 10.5M x 28 iters/s (batched + exact)
+  stress         Expo/Allstate shapes (tools/stress_shapes.py)
+  multiclass     vmap-vs-sequential class batching timing
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(HERE, "onchip_r4_results.json")
+
+
+def load():
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            return json.load(f)
+    return {}
+
+
+def save(results):
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1, sort_keys=True)
+    os.replace(tmp, OUT)
+
+
+def run_step(name, code_or_cmd, results, timeout, env=None, redo=False):
+    if name in results and not redo and results[name].get("ok"):
+        print("[skip] %s (already recorded)" % name, flush=True)
+        return True
+    print("[run ] %s (timeout %ds)" % (name, timeout), flush=True)
+    t0 = time.time()
+    cmd = code_or_cmd if isinstance(code_or_cmd, list) \
+        else [sys.executable, "-c", code_or_cmd]
+    full_env = dict(os.environ, **(env or {}))
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO, env=full_env)
+        rec = {"ok": r.returncode == 0, "seconds": round(time.time() - t0, 1)}
+        # steps print one JSON payload line: either prefixed RESULT:
+        # (the inline steps) or a bare {...} line (bench.py)
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("RESULT:"):
+                rec["data"] = json.loads(line[len("RESULT:"):])
+            elif line.startswith("{") and line.rstrip().endswith("}"):
+                try:
+                    rec["data"] = json.loads(line)
+                except ValueError:
+                    pass
+        if r.returncode != 0:
+            rec["error"] = (r.stderr or r.stdout or "")[-800:]
+    except subprocess.TimeoutExpired:
+        rec = {"ok": False, "seconds": round(time.time() - t0, 1),
+               "error": "timeout after %ds" % timeout}
+    results[name] = rec
+    save(results)
+    print("[%s] %s %s" % ("ok  " if rec["ok"] else "FAIL", name,
+                          rec.get("data", rec.get("error", ""))), flush=True)
+    return rec["ok"]
+
+
+PROBE = r"""
+import json, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((4096, 4096), jnp.bfloat16)
+t1 = time.time(); y = (x @ x).block_until_ready(); t2 = time.time()
+for _ in range(3):
+    y = (x @ x).block_until_ready()
+t3 = time.time()
+print("RESULT:" + json.dumps({
+    "platform": d[0].platform, "kind": str(getattr(d[0], "device_kind", "?")),
+    "n_devices": len(d), "init_s": round(t1 - t0, 1),
+    "matmul_tflops": round(3 * 2 * 4096**3 / max(t3 - t2, 1e-9) / 1e12, 1)}))
+"""
+
+KERNEL_PARITY = r"""
+import json
+import numpy as np
+import jax.numpy as jnp
+from lightgbm_tpu.core.histogram import build_histogram, hist_tile_vals
+from lightgbm_tpu.core.histogram_pallas import build_histogram_slots
+r = np.random.RandomState(7)
+n, f, b, s = 65536, 28, 256, 8
+xb = r.randint(0, b, (n, f)).astype(np.uint8)
+g = r.randn(n).astype(np.float32)
+h = np.abs(r.randn(n)).astype(np.float32)
+m = (r.rand(n) > 0.3).astype(np.float32)
+slot = r.randint(0, s, (n,)).astype(np.int32)
+out = {}
+ref = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                 jnp.asarray(h), jnp.asarray(m),
+                                 num_bins=b, impl="scatter"))
+pal = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                 jnp.asarray(h), jnp.asarray(m),
+                                 num_bins=b, impl="pallas"))
+out["pallas_vs_scatter_max"] = float(np.abs(pal - ref).max())
+hi = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                jnp.asarray(h), jnp.asarray(m),
+                                num_bins=b, impl="pallas_highest"))
+out["pallas_highest_vs_scatter_max"] = float(np.abs(hi - ref).max())
+# 6-channel tile (the fused partition path shape)
+v6 = r.randn(4096, 6).astype(np.float32)
+ref6 = np.asarray(hist_tile_vals(jnp.asarray(xb[:4096]), jnp.asarray(v6),
+                                 b, "scatter"))
+p6 = np.asarray(hist_tile_vals(jnp.asarray(xb[:4096]), jnp.asarray(v6),
+                               b, "pallas"))
+out["tile6_vs_scatter_max"] = float(np.abs(p6 - ref6).max())
+# slot kernel (batched growth): per-slot scatter reference
+vals = np.stack([g * m, h * m, m])           # [3, N] channels
+sl = np.asarray(build_histogram_slots(jnp.asarray(xb), jnp.asarray(slot),
+                                      jnp.asarray(vals), num_bins=b,
+                                      n_slots=s))      # [s, F, B, 3]
+refs = np.stack([np.asarray(build_histogram(
+    jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h),
+    jnp.asarray(m * (slot == i)), num_bins=b, impl="scatter"))
+    for i in range(s)])
+out["slot_kernel_vs_scatter_max"] = float(np.abs(sl - refs).max())
+print("RESULT:" + json.dumps(out))
+"""
+
+FULL_SHAPE = r"""
+import json, os, time
+import numpy as np
+import jax
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.boosting import create_boosting
+n, f = 10_500_000, 28
+r = np.random.RandomState(0)
+X = r.randn(n, f).astype(np.float32)
+y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float32)
+out = {}
+for growth in (os.environ.get("FULL_SHAPE_MODES", "batched,exact")
+               .split(",")):
+    cfg = Config({"objective": "binary", "num_leaves": 255,
+                  "verbosity": -1, "tree_growth": growth})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    b.train_one_iter()   # compile + first iter
+    jax.block_until_ready(b.scores)
+    t0 = time.time()
+    iters = 10
+    b.train_many(iters)
+    jax.block_until_ready(b.scores)
+    dt = (time.time() - t0) / iters
+    out[growth] = {"s_per_iter": round(dt, 3),
+                   "iters_per_sec": round(1.0 / dt, 4)}
+print("RESULT:" + json.dumps(out))
+"""
+
+MULTICLASS = r"""
+import json, time
+import numpy as np
+import jax
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.boosting import create_boosting
+n, f, k = 500_000, 28, 5
+r = np.random.RandomState(0)
+X = r.randn(n, f).astype(np.float32)
+y = (np.abs(X[:, 0] * 2 + r.randn(n)) % k).astype(int).astype(np.float32)
+out = {}
+for slots, name in ((0, "vmap"), (4, "sequential_capped")):
+    cfg = Config({"objective": "multiclass", "num_class": k,
+                  "num_leaves": 63, "verbosity": -1,
+                  **({"histogram_pool_size": 1e-4} if slots else {})})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    b.train_one_iter()
+    jax.block_until_ready(b.scores)
+    t0 = time.time()
+    for _ in range(3):
+        b.train_one_iter()
+    jax.block_until_ready(b.scores)
+    out[name] = {"s_per_iter": round((time.time() - t0) / 3, 3),
+                 "vmapped": bool(b.grow_params.vmapped_classes)}
+print("RESULT:" + json.dumps(out))
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="probe + kernel parity + default bench only")
+    ap.add_argument("--redo", action="store_true",
+                    help="rerun steps that already have results")
+    args = ap.parse_args()
+    results = load()
+    redo = args.redo
+
+    if not run_step("probe", PROBE, results, timeout=360, redo=redo):
+        print("backend unreachable — stopping (results preserved)")
+        return 1
+    run_step("kernel_parity", KERNEL_PARITY, results, timeout=600,
+             redo=redo)
+
+    bench_env = {"BENCH_BACKEND_TRIES": "1", "BENCH_BACKEND_TIMEOUT": "240"}
+    run_step("bench_default", [sys.executable, "bench.py"], results,
+             timeout=1800, env=bench_env, redo=redo)
+    if args.quick:
+        return 0
+    run_step("bench_exact", [sys.executable, "bench.py"], results,
+             timeout=1800, env=dict(bench_env, BENCH_TREE_GROWTH="exact"),
+             redo=redo)
+    for k in (4, 8, 16, 32):
+        run_step("bench_k%d" % k, [sys.executable, "bench.py"], results,
+                 timeout=1800,
+                 env=dict(bench_env, BENCH_BATCH_SPLITS=str(k)), redo=redo)
+    # best K so far, with the packed tile-skip variant
+    best_k, best_v = 16, -1.0
+    for k in (4, 8, 16, 32):
+        d = results.get("bench_k%d" % k, {}).get("data") or {}
+        if d.get("value", -1) > best_v:
+            best_k, best_v = k, d["value"]
+    run_step("bench_pack", [sys.executable, "bench.py"], results,
+             timeout=1800,
+             env=dict(bench_env, BENCH_BATCH_SPLITS=str(best_k),
+                      BENCH_EXTRA_PARAMS="tpu_batched_pack=true"),
+             redo=redo)
+    run_step("full_shape", FULL_SHAPE, results, timeout=3600, redo=redo)
+    run_step("stress", [sys.executable, "tools/stress_shapes.py"], results,
+             timeout=3600, redo=redo)
+    run_step("multiclass", MULTICLASS, results, timeout=1800, redo=redo)
+    print("\nall recorded in", OUT)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
