@@ -94,15 +94,16 @@ def run_bench(backend_info: dict) -> dict:
 
     import jax
     t_setup0 = time.time()
-    # flagship TPU path: batched-frontier growth (top-K splits per step,
-    # docs/Performance.md) — the AUC honesty guard below keeps the
-    # approximation honest. BENCH_TREE_GROWTH=exact for the reference
-    # semantics; BENCH_BATCH_SPLITS sweeps K.
-    growth = os.environ.get("BENCH_TREE_GROWTH", "batched")
+    # round-4 on-chip decision (docs/Performance.md): EXACT growth over
+    # the row partition is the measured winner on TPU (1.97 vs 1.73
+    # iters/s for the best batched config at the bench shape) — the
+    # CPU-measured batched 2.0x inverted on chip. BENCH_TREE_GROWTH
+    # overrides; BENCH_BATCH_SPLITS sweeps K for batched runs.
+    growth = os.environ.get("BENCH_TREE_GROWTH", "exact")
     cfg_d = {"objective": "binary", "num_leaves": num_leaves,
              "max_bin": 255, "verbosity": -1, "tree_growth": growth,
              "tree_batch_splits": int(os.environ.get("BENCH_BATCH_SPLITS",
-                                                     16))}
+                                                     32))}
     # sweep hook: BENCH_HIST_IMPL in {auto, matmul, scatter, pallas}
     if os.environ.get("BENCH_HIST_IMPL"):
         cfg_d["tpu_hist_impl"] = os.environ["BENCH_HIST_IMPL"]

@@ -369,6 +369,31 @@ class GBDT:
             else:
                 batch_splits = min(cfg.tree_batch_splits,
                                    cfg.num_leaves - 1)
+        # partitioned batched growth (core/grow_batched_part.py): the
+        # on-chip winner. auto = whenever the device kernels run it; a
+        # GSPMD mesh path must keep it off (the per-step permutation
+        # would shuffle rows across devices) — the explicit shard_map
+        # data-parallel learner partitions each LOCAL shard and stays on.
+        vmapped = (self.num_tree_per_iteration > 1 and pool_slots == 0)
+        part_ok = (batch_splits > 0 and not vmapped
+                   and (self.mesh is None
+                        or (cfg.tree_learner == "data"
+                            and mesh_mod.DATA_AXIS in self.mesh.axis_names)))
+        if cfg.tpu_batched_part in ("true", "1"):
+            if not part_ok and batch_splits > 0:
+                Log.warning("tpu_batched_part=true is unsupported here "
+                            "(vmapped multiclass or GSPMD mesh path); "
+                            "using the unpartitioned batched step")
+            batched_part = part_ok
+        elif cfg.tpu_batched_part in ("false", "0"):
+            batched_part = False
+        else:
+            # auto = OFF: measured on a v5e chip the per-step permutation
+            # (XLA gather ~2.3 GB/s) and per-tile DMA latency make the
+            # partitioned step LOSE to both exact growth and the joint
+            # slot kernel at 1M x 28 (docs/Performance.md round-4 table);
+            # revisit if those two costs change
+            batched_part = False
 
         # explicit shard_map data-parallel learner: every device partitions
         # its local row shard and only child histograms cross the mesh
@@ -401,7 +426,12 @@ class GBDT:
                 cat_smooth=cfg.cat_smooth, cat_l2=cfg.cat_l2,
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=cfg.min_data_per_group),
-            row_chunk=16384,
+            # 0 = auto: 4096 on TPU (round-4 on-chip sweep: 1.97 vs 1.80
+            # iters/s at 16384; 65536+ strictly worse), 16384 on CPU
+            # (fewer while-loop trips win when indexed ops are cheap)
+            row_chunk=(int(cfg.tpu_row_chunk) or
+                       (4096 if _resolve_hist_impl(cfg).startswith("pallas")
+                        else 16384)),
             # CPU: XLA scatter-add wins; TPU: the Pallas VMEM-accumulator
             # kernel is the default device path (the GPUTreeLearner analog,
             # gpu_tree_learner.cpp:951-1045) — one-hot matmul is the fallback
@@ -417,6 +447,7 @@ class GBDT:
                              and pool_slots == 0),
             batch_splits=batch_splits,
             batched_pack=(batch_splits > 0 and cfg.tpu_batched_pack),
+            batched_part=batched_part,
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
@@ -763,6 +794,17 @@ class GBDT:
                 h = h * mult[:, None]
                 sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
 
+            # one place decides which batched grower runs (the shard_map
+            # and single-device branches below both use it)
+            grow_batched_fn = None
+            if params.batch_splits > 0:
+                if params.batched_part:
+                    from ..core.grow_batched_part import \
+                        grow_tree_batched_part as grow_batched_fn
+                else:
+                    from ..core.grow_batched import \
+                        grow_tree_batched as grow_batched_fn
+
             if fp_capture is not None:
                 # explicit feature-parallel: one shard_map over the feature
                 # axis; rows replicated, column slices + local metas device-
@@ -816,10 +858,8 @@ class GBDT:
                     "batched growth cannot carry CEGB state"
 
                 if params.batch_splits > 0:
-                    from ..core.grow_batched import grow_tree_batched
-
                     def _grow_core(xbj, gj, hj, mj, fm):
-                        return grow_tree_batched(
+                        return grow_batched_fn(
                             xbj, gj, hj, mj, meta, fm, params,
                             axis_name=DATA_AXIS)[:2]
                 elif has_cegb:
@@ -864,11 +904,9 @@ class GBDT:
                                              feature_mask)
                         return t, li, None
             elif params.batch_splits > 0:
-                from ..core.grow_batched import grow_tree_batched
-
                 def grow_one(gk, hk, cs):
-                    return grow_tree_batched(xb, gk, hk, sample_mask, meta,
-                                             feature_mask, params)
+                    return grow_batched_fn(xb, gk, hk, sample_mask, meta,
+                                           feature_mask, params)
             else:
                 def grow_one(gk, hk, cs):
                     return grow_tree(xb, gk, hk, sample_mask, meta,

@@ -178,6 +178,19 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # kernel's compute (cost ~ split-leaf rows, not N); opt-in until
     # measured on chip
     ("tpu_batched_pack", bool, False, []),
+    # partitioned batched growth (core/grow_batched_part.py): rows kept
+    # physically grouped by leaf so per-step kernel cost tracks the
+    # splitting leaves' rows. auto currently = off — the per-step row
+    # permutation measured slower than the kernel savings on chip
+    # (docs/Performance.md); true forces it on for experiments.
+    ("tpu_batched_part", str, "auto", []),
+    # rows per chunk of the partitioned growth loops (core/partition.py).
+    # 0 = auto: 4096 on TPU-shaped backends (measured round-4 winner:
+    # most leaves are far smaller than the old 16384 default, whose
+    # single-trip padded work dominated the per-split floor), 16384
+    # elsewhere. Larger chunks measured strictly worse on chip (65536 ->
+    # 0.59x, 262144 -> 0.22x the 16384 throughput).
+    ("tpu_row_chunk", int, 0, []),
 ]
 
 _CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}
@@ -359,6 +372,13 @@ class Config:
                                 "got %s" % self.tree_growth)
         if self.tree_batch_splits < 1:
             raise LightGBMError("tree_batch_splits should be >= 1")
+        self.tpu_batched_part = str(self.tpu_batched_part).strip().lower()
+        if self.tpu_batched_part not in ("auto", "true", "false", "1", "0"):
+            raise LightGBMError("tpu_batched_part should be auto, true or "
+                                "false, got %s" % self.tpu_batched_part)
+        if self.tpu_row_chunk < 0:
+            raise LightGBMError("tpu_row_chunk should be >= 0 (0 = auto), "
+                                "got %s" % self.tpu_row_chunk)
         if self.verbosity >= 0:
             Log.reset_level(self.verbosity)
 

@@ -115,6 +115,13 @@ class GrowParams(NamedTuple):
     # tiles skip the slot kernel's compute body (tpu_batched_pack; opt-in
     # until measured on chip)
     batched_pack: bool = False
+    # partitioned batched growth (core/grow_batched_part.py): rows kept
+    # physically grouped by leaf in tile-aligned segments; per-step
+    # KERNEL cost tracks the splitting leaves' rows with no slot-one-hot
+    # redundancy — but the per-step row permutation (XLA gather) measured
+    # slower than the kernel savings on a v5e chip, so this stays opt-in
+    # (docs/Performance.md round-4 table)
+    batched_part: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -324,12 +331,17 @@ def _bin_go_left(col: jnp.ndarray, threshold: jnp.ndarray,
     (cat_bitset [N, 8], every param [N] — batched-frontier routing); the
     missing-value and categorical semantics must stay in exactly one
     place so exact growth, batched growth, and predict cannot diverge.
+    ``is_cat=None`` skips the categorical branch entirely (datasets with
+    no categorical features — avoids materializing [N, 8] bitset gathers
+    in the batched routing pass).
     """
     coli = col.astype(jnp.int32)
     is_missing = jnp.where(
         missing_type == MISSING_NAN, coli == num_bin - 1,
         jnp.where(missing_type == MISSING_ZERO, coli == default_bin, False))
     numerical = jnp.where(is_missing, default_left, coli <= threshold)
+    if is_cat is None:
+        return numerical
     if cat_bitset.ndim == 1:
         word = cat_bitset[coli >> 5]
     else:

@@ -152,6 +152,243 @@ def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
     return jnp.moveaxis(out, 0, -1)[:f, :num_bins]           # [F, B, 3]
 
 
+def _hist_slot6_kernel(xb_ref, slot_ref, sel_ref, vals_ref, out_ref, *,
+                       hi_n: int, n_slots: int, highest: bool):
+    """Joint slot kernel, PARENT-slot x 6-channel variant (round-4 MXU
+    fix): rows carry their splitting PARENT's rank (n_slots = K) and a
+    go-left selector; the kernel routes (g, h, m) into left/right channel
+    triples, so both children come out of half the slot one-hot width of
+    the child-slot variant above — 2x fewer MXU column passes AND 2x the
+    systolic-row utilization (M = 6*Hi = 96 vs 48).
+    """
+    r = pl.program_id(1)
+    slot = slot_ref[...].astype(jnp.int32)                   # [1, C]
+    sel = sel_ref[...]                                       # [1, C]
+    v3 = vals_ref[...]                                       # [3, C]
+    xb = xb_ref[...].astype(jnp.int32)                       # [Ft, C]
+    ft, c = xb.shape
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(jnp.any(slot >= 0))
+    def _body():
+        v6 = jnp.concatenate([v3 * sel, v3 * (1.0 - sel)],
+                             axis=0)                         # [6, C]
+        iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+        iota_hi = jax.lax.broadcasted_iota(jnp.int32, (hi_n, c), 0)
+        iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_slots, c), 0)
+        s_eq = iota_s == slot                                # [S, C]
+        for j in range(ft):
+            x = xb[j:j + 1, :]
+            hi_eq = iota_hi == (x >> 4)
+            lo_eq = iota_lo == (x & 15)
+            a = jnp.where(hi_eq[None, :, :], v6[:, None, :],
+                          0.0).reshape(6 * hi_n, c)          # [6*Hi, C]
+            eqj = jnp.where(s_eq[:, None, :] & lo_eq[None, :, :], 1.0,
+                            0.0).reshape(n_slots * 16, c)    # [S*16, C]
+            if highest:
+                part = jax.lax.dot_general(
+                    a, eqj, (((1,), (1,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+            else:
+                a_top = a.astype(jnp.bfloat16)
+                a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+                eqb = eqj.astype(jnp.bfloat16)
+                part = jax.lax.dot_general(
+                    a_top, eqb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                part += jax.lax.dot_general(
+                    a_rem, eqb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            out_ref[:, j, :, :] += part.reshape(6, hi_n, n_slots * 16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "n_slots", "row_tile",
+                                    "feature_tile", "interpret", "highest"))
+def build_histogram_slots6(xb: jnp.ndarray, slot: jnp.ndarray,
+                           sel: jnp.ndarray, vals: jnp.ndarray,
+                           num_bins: int, n_slots: int,
+                           row_tile: int = 2048, feature_tile: int = 8,
+                           interpret: bool = False,
+                           highest: bool = False) -> jnp.ndarray:
+    """[N, F] uint8 bins + per-row PARENT-slot ids (-1 = inactive) +
+    per-row go-left selector + [3, N] value channels ->
+    [n_slots, F, B, 6] f32: channels [g,h,m]*sel then [g,h,m]*(1-sel) —
+    both children of every splitting parent in one pass, at half the
+    one-hot width of build_histogram_slots."""
+    n, f = xb.shape
+    hi_n = max(1, (num_bins + 15) // 16)
+    f_pad = (-f) % feature_tile
+    n_pad = (-n) % row_tile
+    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad))).astype(jnp.uint8)
+    slot2 = jnp.minimum(slot.astype(jnp.int32), n_slots - 1)
+    slot2 = jnp.pad(slot2, (0, n_pad), constant_values=-1)[None, :]
+    sel2 = jnp.pad(sel.astype(jnp.float32), (0, n_pad))[None, :]
+    vals = jnp.pad(vals, ((0, 0), (0, n_pad)))
+    fp = f + f_pad
+
+    kernel = functools.partial(_hist_slot6_kernel, hi_n=hi_n,
+                               n_slots=n_slots, highest=highest)
+    out = pl.pallas_call(
+        kernel,
+        grid=(fp // feature_tile, (n + n_pad) // row_tile),
+        in_specs=[
+            pl.BlockSpec((feature_tile, row_tile), lambda i, r: (i, r)),
+            pl.BlockSpec((1, row_tile), lambda i, r: (0, r)),
+            pl.BlockSpec((1, row_tile), lambda i, r: (0, r)),
+            pl.BlockSpec((3, row_tile), lambda i, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((6, feature_tile, hi_n, n_slots * 16),
+                               lambda i, r: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((6, fp, hi_n, n_slots * 16),
+                                       jnp.float32),
+        interpret=interpret,
+    )(xb_t, slot2, sel2, vals)
+    # [6, F, Hi, S, 16] -> [S, F, B, 6]
+    out = out.reshape(6, fp, hi_n, n_slots, 16)
+    out = jnp.transpose(out, (3, 1, 2, 4, 0)).reshape(
+        n_slots, fp, hi_n * 16, 6)
+    return out[:, :f, :num_bins]
+
+
+def _hist_part_kernel(tile_slot_ref, tile_first_ref, xb_ref, sel_ref,
+                      vals_ref, out_ref, *, hi_n: int, highest: bool):
+    """One (feature_tile, row_tile) grid cell of the PARTITIONED batched
+    kernel (core/grow_batched_part.py): rows arrive physically grouped by
+    leaf into row_tile-ALIGNED segments, so every row tile belongs to at
+    most ONE frontier slot — the tile->slot map rides in scalar-prefetch
+    SMEM and drives the OUTPUT BlockSpec index directly. Unlike the joint
+    slot kernel above, no S-wide one-hot ever materializes: per-row work
+    is the base digit kernel's (the joint kernel pays S x redundant MXU
+    work because each row matches exactly one of its S x 16 columns).
+
+    Six value channels per slot: ``sel`` in {1.0, 0.0} routes each row's
+    (g, h, m) into the first or second channel triple — both children of
+    a splitting leaf (sel = go_left) in ONE pass over the parent's rows,
+    at BETTER MXU utilization than 3 channels (M = 6*Hi = 96 rows of the
+    systolic array instead of 48).
+
+    tile_slot[t] == -1 marks a tile with no frontier rows: its compute
+    body is skipped entirely, so per-step cost tracks the splitting
+    leaves' rows, not N. tile_first[t] == 1 marks the first tile of a
+    slot's run and zero-initializes the accumulator (blocks of slots that
+    never appear keep garbage — callers mask invalid slots after).
+
+    Pallas TPU's pipelined output machinery requires every output block
+    to be visited in ONE contiguous grid run — revisiting a block after
+    visiting others corrupts it via the stale double-buffer (measured on
+    a v5e chip: mapping inactive tiles to slot 0 silently mixed partial
+    sums into slot 0's result). Inactive tiles therefore index a
+    DEDICATED dummy block (slot n_slots) whose garbage content is
+    dropped by the caller; real slots are each one contiguous segment of
+    the layout, so they are never revisited.
+    """
+    r = pl.program_id(1)
+    slot = tile_slot_ref[r]
+
+    @pl.when(tile_first_ref[r] == 1)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(slot >= 0)
+    def _body():
+        xb = xb_ref[...].astype(jnp.int32)                   # [Ft, C]
+        sel = sel_ref[...]                                   # [1, C]
+        v3 = vals_ref[...]                                   # [3, C]
+        ft, c = xb.shape
+        v6 = jnp.concatenate([v3 * sel, v3 * (1.0 - sel)],
+                             axis=0)                         # [6, C]
+        iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+        iota_hi = jax.lax.broadcasted_iota(jnp.int32, (hi_n, c), 0)
+        for j in range(ft):
+            x = xb[j:j + 1, :]                               # [1, C]
+            hi_eq = iota_hi == (x >> 4)                      # [Hi, C]
+            lo_eq = iota_lo == (x & 15)                      # [16, C]
+            a = jnp.where(hi_eq[None, :, :], v6[:, None, :],
+                          0.0).reshape(6 * hi_n, c)          # [6*Hi, C]
+            if highest:
+                eqlo = jnp.where(lo_eq, 1.0, 0.0)
+                part = jax.lax.dot_general(
+                    a, eqlo, (((1,), (1,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)      # [6*Hi, 16]
+            else:
+                a_top = a.astype(jnp.bfloat16)
+                a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+                eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
+                part = jax.lax.dot_general(
+                    a_top, eqlo, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                part += jax.lax.dot_general(
+                    a_rem, eqlo, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            out_ref[0, :, j, :, :] += part.reshape(6, hi_n, 16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "n_slots", "row_tile",
+                                    "feature_tile", "interpret", "highest"))
+def build_histogram_part_tiles(xb_fm: jnp.ndarray, sel: jnp.ndarray,
+                               vals: jnp.ndarray, tile_slot: jnp.ndarray,
+                               tile_first: jnp.ndarray, num_bins: int,
+                               n_slots: int, row_tile: int = 2048,
+                               feature_tile: int = 8,
+                               interpret: bool = False,
+                               highest: bool = False) -> jnp.ndarray:
+    """Partitioned-layout histograms: [F, Np] FEATURE-MAJOR uint8 bins
+    (Np a multiple of row_tile, rows grouped into tile-aligned leaf
+    segments) + per-row channel selector + [3, Np] value channels +
+    per-tile slot/first maps -> [n_slots, F, B, 6] f32.
+
+    Channel order per slot: [g*sel, h*sel, m*sel, g*(1-sel), h*(1-sel),
+    m*(1-sel)] — left child then right child when sel = go_left. Rows in
+    tiles with tile_slot == -1 and rows whose value channels are zero
+    (segment padding) contribute nothing. Slots with no tiles keep
+    UNINITIALIZED memory — mask invalid slots downstream.
+    """
+    f, np_ = xb_fm.shape
+    assert np_ % row_tile == 0, "partitioned layout must be tile-aligned"
+    hi_n = max(1, (num_bins + 15) // 16)
+    f_pad = (-f) % feature_tile
+    xb_p = jnp.pad(xb_fm, ((0, f_pad), (0, 0))).astype(jnp.uint8)
+    fp = f + f_pad
+    t = np_ // row_tile
+
+    from jax.experimental.pallas import tpu as pltpu
+    kernel = functools.partial(_hist_part_kernel, hi_n=hi_n,
+                               highest=highest)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(fp // feature_tile, t),
+        in_specs=[
+            pl.BlockSpec((feature_tile, row_tile),
+                         lambda i, r, *_: (i, r)),
+            pl.BlockSpec((1, row_tile), lambda i, r, *_: (0, r)),
+            pl.BlockSpec((3, row_tile), lambda i, r, *_: (0, r)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 6, feature_tile, hi_n, 16),
+            lambda i, r, slot_ref, first_ref: (
+                jnp.where(slot_ref[r] < 0, n_slots, slot_ref[r]),
+                0, i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_slots + 1, 6, fp, hi_n, 16),
+                                       jnp.float32),
+        interpret=interpret,
+    )(tile_slot.astype(jnp.int32), tile_first.astype(jnp.int32),
+      xb_p, sel[None, :], vals)
+    # [S+1, 6, Fp, Hi, 16] -> [S, F, B, 6] (dummy slot dropped)
+    out = out[:n_slots].reshape(n_slots, 6, fp, hi_n * 16)
+    return jnp.transpose(out, (0, 2, 3, 1))[:, :f, :num_bins]
+
+
 def _hist_slot_kernel(xb_ref, slot_ref, vals_ref, out_ref, *, hi_n: int,
                       n_slots: int, highest: bool):
     """One (feature_tile, row_tile) grid cell of the SLOT-EXTENDED digit

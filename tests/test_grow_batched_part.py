@@ -1,0 +1,93 @@
+"""Partitioned batched growth (core/grow_batched_part.py,
+tpu_batched_part=true).
+
+Contract being pinned:
+- identical SPLIT STRUCTURE to the unpartitioned batched mode (same
+  top-K frontier algorithm; only histogram summation order differs);
+- the tile-pure Pallas kernel path (interpret mode) matches the
+  scatter-based combined-index fallback;
+- the shard_map data-parallel path reproduces the single-device model
+  (each device partitions its LOCAL row shard; one psum per step);
+- auto policy keeps it OFF (measured slower on chip, see
+  docs/Performance.md round-4 table) while true forces it on.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.boosting import create_boosting
+
+from conftest import make_binary
+
+
+def _train(X, y, params, rounds=4, **ds_kw):
+    cfg = Config(params)
+    ds = BinnedDataset.from_matrix(X, cfg, label=y, **ds_kw)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+    for _ in range(rounds):
+        if b.train_one_iter():
+            break
+    return b
+
+
+BASE = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+        "min_data_in_leaf": 5, "tree_growth": "batched",
+        "tree_batch_splits": 4, "tpu_hist_impl": "scatter"}
+
+
+def test_part_matches_plain_batched_structure():
+    X, y = make_binary(n=3000)
+    b0 = _train(X, y, dict(BASE))
+    b1 = _train(X, y, dict(BASE, tpu_batched_part="true"))
+    assert b1.grow_params.batched_part
+    assert not b0.grow_params.batched_part    # auto stays off
+    for t0, t1 in zip(b0.models, b1.models):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
+        np.testing.assert_array_equal(np.asarray(t0.threshold_bin),
+                                      np.asarray(t1.threshold_bin))
+        np.testing.assert_array_equal(np.asarray(t0.split_leaf),
+                                      np.asarray(t1.split_leaf))
+    p0 = b0.predict(X[:300], raw_score=True)
+    p1 = b1.predict(X[:300], raw_score=True)
+    np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-4)
+
+
+def test_part_kernel_matches_fallback():
+    """The tile-pure kernel (interpret) vs the combined-index scatter
+    build, end to end. n spans multiple 2048-row tiles so segments
+    really cross tile boundaries and late steps leave inactive tiles."""
+    X, y = make_binary(n=6000, f=6)
+    base = dict(BASE, num_leaves=63, tpu_batched_part="true")
+    bs = _train(X, y, dict(base))
+    bp = _train(X, y, dict(base, tpu_hist_impl="pallas_interpret"))
+    ps = bs.predict(X[:300], raw_score=True)
+    pp = bp.predict(X[:300], raw_score=True)
+    np.testing.assert_allclose(ps, pp, rtol=2e-3, atol=2e-3)
+
+
+def test_part_data_parallel_matches_single_device():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    X, y = make_binary(n=2048)
+    base = dict(BASE, tree_batch_splits=8, tpu_batched_part="true")
+    b1 = _train(X, y, dict(base))
+    b8 = _train(X, y, dict(base, tree_learner="data", num_machines=1,
+                           mesh_shape=[8]))
+    p1 = b1.predict(X[:200], raw_score=True)
+    p8 = b8.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(p1, p8, rtol=2e-4, atol=2e-4)
+
+
+def test_part_bagging_and_goss_ride_along():
+    """Masked-out rows still travel through the partition (their leaf
+    assignment must stay correct for the score update)."""
+    X, y = make_binary(n=4000)
+    b = _train(X, y, dict(BASE, tpu_batched_part="true",
+                          bagging_fraction=0.6, bagging_freq=1), rounds=6)
+    pred = b.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, np.asarray(b.scores)[:, 0],
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,77 @@
+"""Full HIGGS-shape (10.5M x 28) on-chip measurement, round 4.
+
+Exact vs batched(K=32) at the reference benchmark's real scale —
+the HIGGS-normalized metric is linear in rows, so the ~1 ms/split
+latency floor (N-independent) makes the full shape the honest best
+configuration. Appends to tools/onchip_r4_results.json.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "onchip_r4_results.json")
+sys.path.insert(0, os.path.dirname(HERE))   # repo root for lightgbm_tpu
+
+
+def main():
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+
+    n, f = 10_500_000, 28
+    r = np.random.RandomState(0)
+    X = r.randn(n, f).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float32)
+    modes = {
+        "exact": {"tree_growth": "exact"},
+        "batched_k32": {"tree_growth": "batched", "tree_batch_splits": 32},
+    }
+    wanted = os.environ.get("FULL_SHAPE_MODES", "exact,batched_k32")
+    out = {}
+    for name in wanted.split(","):
+        extra = modes[name.strip()]
+        try:
+            cfg = Config({"objective": "binary", "num_leaves": 255,
+                          "verbosity": -1, **extra})
+            t0 = time.time()
+            ds = BinnedDataset.from_matrix(X, cfg, label=y)
+            b = create_boosting(cfg, ds, create_objective(cfg), [])
+            t_bin = time.time() - t0
+            t0 = time.time()
+            b.train_many(2)           # compile + warm
+            jax.block_until_ready(b.scores)
+            t_warm = time.time() - t0
+            iters = 10
+            t0 = time.time()
+            b.train_many(iters)
+            jax.block_until_ready(b.scores)
+            dt = (time.time() - t0) / iters
+            out[name] = {
+                "s_per_iter": round(dt, 3),
+                "iters_per_sec": round(1.0 / dt, 4),
+                "vs_baseline": round((1.0 / dt) / (500.0 / 238.505), 4),
+                "bin_s": round(t_bin, 1), "warm_s": round(t_warm, 1)}
+            del b, ds
+        except Exception as e:  # noqa: BLE001 - record and continue
+            out[name] = {"error": repr(e)[:300]}
+        print(name, out[name], flush=True)
+
+    results = {}
+    if os.path.exists(OUT):
+        with open(OUT) as fh:
+            results = json.load(fh)
+    results["full_shape_r4"] = {"ok": True, "data": out}
+    with open(OUT + ".tmp", "w") as fh:
+        json.dump(results, fh, indent=1, sort_keys=True)
+    os.replace(OUT + ".tmp", OUT)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,355 @@
+"""On-chip histogram-kernel experiments (round 4).
+
+Findings this script established (see docs/Performance.md):
+- the per-feature digit kernel is BANDWIDTH-bound when fed feature-major
+  input directly (~0.2-0.5 ms per 1M x 28 x 256 pass) — the 29 ms
+  production number was the un-hoisted [N, F] -> [F, N] uint8 transpose
+  plus dispatch, not the matmuls;
+- a data-dependent (scalar-prefetch) OUTPUT BlockSpec index defeats the
+  output pipeliner (per-cell fetch+writeback, ~14 ms per pass); keeping
+  the whole per-slot accumulator as ONE constant-index block restores
+  full speed;
+- the joint slot one-hot's S-factor is real MXU work: measured cost vs
+  n_slots quantifies what tile-pure partitioning saves.
+
+Usage: python tools/kernel_lab.py
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+N, F, B = 1_048_576, 28, 256
+FP = 32
+HI = 16
+
+
+def timed(run, args_list, n_iter=20):
+    out = run(*args_list[0])
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for i in range(n_iter):
+        out = run(*args_list[i % len(args_list)])
+    jax.block_until_ready(out)
+    return (time.time() - t0) / n_iter * 1000, out
+
+
+def v0_kernel(xb_ref, vals_ref, out_ref):
+    r = pl.program_id(1)
+    xb = xb_ref[...].astype(jnp.int32)
+    vals = vals_ref[...]
+    ft, c = xb.shape
+    k = vals.shape[0]
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (HI, c), 0)
+    for j in range(ft):
+        x = xb[j:j + 1, :]
+        hi_eq = iota_hi == (x >> 4)
+        lo_eq = iota_lo == (x & 15)
+        a = jnp.where(hi_eq[None], vals[:, None, :], 0.0).reshape(k * HI, c)
+        a_top = a.astype(jnp.bfloat16)
+        a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+        eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            a_top, eqlo, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        part += jax.lax.dot_general(
+            a_rem, eqlo, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[:, j, :, :] += part.reshape(k, HI, 16)
+
+
+def mk_v0(row_tile, feature_tile=8, k=3):
+    @jax.jit
+    def run(xb_t, vals):
+        return pl.pallas_call(
+            v0_kernel,
+            grid=(FP // feature_tile, N // row_tile),
+            in_specs=[
+                pl.BlockSpec((feature_tile, row_tile), lambda i, r: (i, r)),
+                pl.BlockSpec((k, row_tile), lambda i, r: (0, r)),
+            ],
+            out_specs=pl.BlockSpec((k, feature_tile, HI, 16),
+                                   lambda i, r: (0, i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((k, FP, HI, 16), jnp.float32),
+        )(xb_t, vals)
+    return run
+
+
+def slot_scratch_kernel(tile_slot_ref, xb_ref, sel_ref, vals_ref, out_ref,
+                        *, n_slots):
+    """Partitioned-tile kernel, VMEM-resident accumulator: out is ONE
+    constant-index block [S, 6, ft, Hi, 16]; the prefetched tile slot
+    only selects the accumulator SLICE (dynamic leading index), so the
+    output pipeliner sees a resident block for the whole row sweep."""
+    r = pl.program_id(1)
+    slot = tile_slot_ref[r]
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(slot >= 0)
+    def _body():
+        xb = xb_ref[...].astype(jnp.int32)
+        sel = sel_ref[...]
+        v3 = vals_ref[...]
+        ft, c = xb.shape
+        v6 = jnp.concatenate([v3 * sel, v3 * (1.0 - sel)], axis=0)
+        iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+        iota_hi = jax.lax.broadcasted_iota(jnp.int32, (HI, c), 0)
+        for j in range(ft):
+            x = xb[j:j + 1, :]
+            hi_eq = iota_hi == (x >> 4)
+            lo_eq = iota_lo == (x & 15)
+            a = jnp.where(hi_eq[None], v6[:, None, :], 0.0) \
+                .reshape(6 * HI, c)
+            a_top = a.astype(jnp.bfloat16)
+            a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+            eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                a_top, eqlo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            part += jax.lax.dot_general(
+                a_rem, eqlo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out_ref[slot, :, j, :, :] += part.reshape(6, HI, 16)
+
+
+def mk_slot_scratch(n_slots, row_tile=2048, feature_tile=8):
+    from jax.experimental.pallas import tpu as pltpu
+    kernel = functools.partial(slot_scratch_kernel, n_slots=n_slots)
+
+    @jax.jit
+    def run(xb_t, sel, vals, tile_slot):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(FP // feature_tile, N // row_tile),
+            in_specs=[
+                pl.BlockSpec((feature_tile, row_tile),
+                             lambda i, r, *_: (i, r)),
+                pl.BlockSpec((1, row_tile), lambda i, r, *_: (0, r)),
+                pl.BlockSpec((3, row_tile), lambda i, r, *_: (0, r)),
+            ],
+            out_specs=pl.BlockSpec(
+                (n_slots, 6, feature_tile, HI, 16),
+                lambda i, r, *_: (0, 0, i, 0, 0)),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n_slots, 6, FP, HI, 16),
+                                           jnp.float32),
+        )(tile_slot.astype(jnp.int32), xb_t, sel[None, :], vals)
+    return run
+
+
+def joint_kernel(xb_ref, slot_ref, vals_ref, out_ref, *, n_slots):
+    """Existing joint (slot x lo) design: RHS width n_slots*16."""
+    r = pl.program_id(1)
+    slot = slot_ref[...].astype(jnp.int32)
+    vals = vals_ref[...]
+    k = vals.shape[0]
+    xb = xb_ref[...].astype(jnp.int32)
+    ft, c = xb.shape
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (HI, c), 0)
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_slots, c), 0)
+    s_eq = iota_s == slot
+    for j in range(ft):
+        x = xb[j:j + 1, :]
+        hi_eq = iota_hi == (x >> 4)
+        lo_eq = iota_lo == (x & 15)
+        a = jnp.where(hi_eq[None], vals[:, None, :], 0.0).reshape(k * HI, c)
+        eqj = jnp.where(s_eq[:, None, :] & lo_eq[None], 1.0, 0.0) \
+            .reshape(n_slots * 16, c)
+        a_top = a.astype(jnp.bfloat16)
+        a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+        eqb = eqj.astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            a_top, eqb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        part += jax.lax.dot_general(
+            a_rem, eqb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[:, j, :, :] += part.reshape(k, HI, n_slots * 16)
+
+
+def mk_joint(n_slots, row_tile=2048, feature_tile=8):
+    kernel = functools.partial(joint_kernel, n_slots=n_slots)
+
+    @jax.jit
+    def run(xb_t, slot, vals):
+        return pl.pallas_call(
+            kernel,
+            grid=(FP // feature_tile, N // row_tile),
+            in_specs=[
+                pl.BlockSpec((feature_tile, row_tile), lambda i, r: (i, r)),
+                pl.BlockSpec((1, row_tile), lambda i, r: (0, r)),
+                pl.BlockSpec((3, row_tile), lambda i, r: (0, r)),
+            ],
+            out_specs=pl.BlockSpec((3, feature_tile, HI, n_slots * 16),
+                                   lambda i, r: (0, i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((3, FP, HI, n_slots * 16),
+                                           jnp.float32),
+        )(xb_t, slot[None, :], vals)
+    return run
+
+
+def pertile_kernel(act_ref, xb_ref, sel_ref, vals_ref, out_ref):
+    """Per-TILE histogram output, STATIC index maps only: cell (i, r)
+    writes its tile's [6, ft, Hi, 16] block to out[r]; the caller
+    reduces tiles -> slots with one [S, T] one-hot matmul (inactive
+    tiles carry one-hot weight 0). act_ref gates compute: inactive
+    tiles just zero their block (garbage x 0 would still poison via
+    NaN, so the zero matters)."""
+    r = pl.program_id(1)
+    act = act_ref[r]
+
+    @pl.when(act == 0)
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(act != 0)
+    def _body():
+        xb = xb_ref[...].astype(jnp.int32)
+        sel = sel_ref[...]
+        v3 = vals_ref[...]
+        ft, c = xb.shape
+        v6 = jnp.concatenate([v3 * sel, v3 * (1.0 - sel)], axis=0)
+        iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, c), 0)
+        iota_hi = jax.lax.broadcasted_iota(jnp.int32, (HI, c), 0)
+        for j in range(ft):
+            x = xb[j:j + 1, :]
+            hi_eq = iota_hi == (x >> 4)
+            lo_eq = iota_lo == (x & 15)
+            a = jnp.where(hi_eq[None], v6[:, None, :], 0.0) \
+                .reshape(6 * HI, c)
+            a_top = a.astype(jnp.bfloat16)
+            a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+            eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                a_top, eqlo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            part += jax.lax.dot_general(
+                a_rem, eqlo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out_ref[0, :, j, :, :] = part.reshape(6, HI, 16)
+
+
+def mk_pertile(n_slots, row_tile=2048, feature_tile=8):
+    from jax.experimental.pallas import tpu as pltpu
+    t = N // row_tile
+
+    @jax.jit
+    def run(xb_t, sel, vals, tile_slot):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(FP // feature_tile, t),
+            in_specs=[
+                pl.BlockSpec((feature_tile, row_tile),
+                             lambda i, r, *_: (i, r)),
+                pl.BlockSpec((1, row_tile), lambda i, r, *_: (0, r)),
+                pl.BlockSpec((3, row_tile), lambda i, r, *_: (0, r)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 6, feature_tile, HI, 16),
+                lambda i, r, *_: (r, 0, i, 0, 0)),
+        )
+        act = (tile_slot >= 0).astype(jnp.int32)
+        tiles = pl.pallas_call(
+            pertile_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((t, 6, FP, HI, 16),
+                                           jnp.float32),
+        )(act, xb_t, sel[None, :], vals)
+        seg = (tile_slot[None, :]
+               == jnp.arange(n_slots, dtype=jnp.int32)[:, None]) \
+            .astype(jnp.float32)                        # [S, T]
+        return jnp.einsum("st,tcfhl->scfhl", seg, tiles)
+    return run
+
+
+def main():
+    r = np.random.RandomState(0)
+    xb_np = r.randint(0, B, (F, N)).astype(np.uint8)
+    xb_t = jnp.asarray(np.concatenate(
+        [xb_np, np.zeros((FP - F, N), np.uint8)], axis=0))
+    xb_rm = jnp.asarray(np.ascontiguousarray(xb_np.T))   # [N, F] row-major
+    vals_sets = [jnp.asarray(r.randn(3, N).astype(np.float32))
+                 for _ in range(4)]
+    sel = jnp.asarray((r.rand(N) > 0.5).astype(np.float32))
+
+    # 0) methodology guard: exact numpy reference for the LAST input set
+    run = mk_v0(2048)
+    ms, out = timed(run, [(xb_t, v) for v in vals_sets])
+    ref = np.zeros((3, F, B), np.float32)
+    v_last = np.asarray(vals_sets[(20 - 1) % 4])
+    for ch in range(3):
+        for f in range(F):
+            np.add.at(ref[ch, f], xb_np[f], v_last[ch])
+    got = np.asarray(out).reshape(3, FP, B)[:, :F]
+    print("v0 rt=2048 (varied inputs)   : %6.2f ms  err=%.1e"
+          % (ms, np.abs(got - ref).max()), flush=True)
+
+    # 1) transpose cost (what build_histogram pays when not hoisted)
+    tr = jax.jit(lambda x: jnp.pad(x.T, ((0, FP - F), (0, 0))))
+    ms, _ = timed(tr, [(xb_rm,)])
+    print("uint8 [N,F]->[F,N] transpose : %6.2f ms" % ms, flush=True)
+
+    # 2) per-tile + segment-matmul (partition-pure tiles, static index)
+    for s, frac in ((16, 2), (16, 1), (32, 1)):
+        ts = np.full(N // 2048, -1, np.int32)
+        nact = N // (2048 * frac)
+        ts[:nact] = np.arange(nact) % s
+        args = [(xb_t, sel, v, jnp.asarray(ts)) for v in vals_sets]
+        try:
+            ms, out = timed(mk_pertile(s), args)
+            # spot parity on slot 0 of the last set
+            sel_np = np.asarray(sel)
+            refs = np.zeros((F, B, 6), np.float32)
+            rows = np.concatenate([np.arange(t * 2048, (t + 1) * 2048)
+                                   for t in range(nact)
+                                   if ts[t] == 0])
+            for ch in range(6):
+                w = sel_np[rows] if ch < 3 else 1 - sel_np[rows]
+                v = v_last[ch % 3, rows] * w
+                for f in range(F):
+                    np.add.at(refs[f, :, ch], xb_np[f, rows], v)
+            got = np.transpose(np.asarray(out[0]).reshape(6, FP, B),
+                               (1, 2, 0))[:F]
+            print("per-tile S=%-3d 1/%d active  : %6.2f ms  err=%.1e"
+                  % (s, frac, ms, np.abs(got - refs).max()), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print("per-tile S=%-3d 1/%d active  : FAIL %s"
+                  % (s, frac, repr(e)[:150]), flush=True)
+
+    # 3) joint slot kernel (existing design) vs S
+    slot_ids = jnp.asarray(r.randint(0, 32, (N,)).astype(np.int32))
+    for s in (8, 16, 32):
+        sl = jnp.minimum(slot_ids, s - 1)
+        try:
+            ms, _ = timed(mk_joint(s), [(xb_t, sl, v) for v in vals_sets])
+            print("joint slots S=%-3d full-N    : %6.2f ms" % (s, ms),
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print("joint slots S=%-3d full-N    : FAIL %s"
+                  % (s, repr(e)[:150]), flush=True)
+
+
+if __name__ == "__main__":
+    main()
