@@ -36,6 +36,7 @@ from ..io.dataset import BinnedDataset
 from ..io.binning import BinType, MissingType as BinMissingType
 from ..core.split import FeatureMeta, SplitParams
 from ..core.grow import GrowParams, TreeArrays, empty_tree, grow_tree
+from ..core import partition as partition_mod
 from ..core.pack import pack_trees, unpack_tree
 from ..core import tree as tree_mod
 from ..objectives import ObjectiveFunction
@@ -369,12 +370,21 @@ class GBDT:
             else:
                 batch_splits = min(cfg.tree_batch_splits,
                                    cfg.num_leaves - 1)
-        # partitioned batched growth (core/grow_batched_part.py): the
-        # on-chip winner. auto = whenever the device kernels run it; a
-        # GSPMD mesh path must keep it off (the per-step permutation
-        # would shuffle rows across devices) — the explicit shard_map
-        # data-parallel learner partitions each LOCAL shard and stays on.
-        vmapped = (self.num_tree_per_iteration > 1 and pool_slots == 0)
+        # multiclass class batching: vmapped growth measured 1.9x SLOWER
+        # than sequential per-class growth on a v5e chip (1.65 vs 0.88
+        # s/iter at 500k x 28 x 5 classes, tools/onchip_r4_results.json
+        # "multiclass") — vmap serializes the growth while_loop in
+        # lockstep AND forces the sort-placement fast path off. TPU-shaped
+        # backends (the same allow-list predicate the sort-placement
+        # policy uses — NOT a hist-impl proxy, so f64/matmul TPU runs are
+        # covered too) therefore grow classes sequentially even with an
+        # uncapped pool; vmap remains the CPU default, where it wins.
+        vmapped = (self.num_tree_per_iteration > 1 and pool_slots == 0
+                   and not partition_mod.tpu_shaped_backend())
+        # partitioned batched growth (core/grow_batched_part.py): a GSPMD
+        # mesh path must keep it off (the per-step permutation would
+        # shuffle rows across devices) — the explicit shard_map
+        # data-parallel learner partitions each LOCAL shard and may use it.
         part_ok = (batch_splits > 0 and not vmapped
                    and (self.mesh is None
                         or (cfg.tree_learner == "data"
@@ -406,6 +416,9 @@ class GBDT:
             and cfg.tree_learner == "data"
             and mesh_mod.DATA_AXIS in self.mesh.axis_names)
 
+        # resolved once: _resolve_hist_impl logs a user-facing warning on
+        # the f64-routes-off-pallas path, which must not repeat per call
+        hist_impl = _resolve_hist_impl(cfg)
         self.grow_params = GrowParams(
             num_leaves=cfg.num_leaves,
             num_bins=self.num_bins,
@@ -430,12 +443,12 @@ class GBDT:
             # iters/s at 16384; 65536+ strictly worse), 16384 on CPU
             # (fewer while-loop trips win when indexed ops are cheap)
             row_chunk=(int(cfg.tpu_row_chunk) or
-                       (4096 if _resolve_hist_impl(cfg).startswith("pallas")
+                       (4096 if hist_impl.startswith("pallas")
                         else 16384)),
             # CPU: XLA scatter-add wins; TPU: the Pallas VMEM-accumulator
             # kernel is the default device path (the GPUTreeLearner analog,
             # gpu_tree_learner.cpp:951-1045) — one-hot matmul is the fallback
-            hist_impl=_resolve_hist_impl(cfg),
+            hist_impl=hist_impl,
             hist_dtype=_hist_dtype(cfg),
             voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
                           and self.mesh is not None else 0),
@@ -443,8 +456,7 @@ class GBDT:
                                   .any()),
             use_partition=(self.mesh is None or self._partition_on_mesh),
             partition_on_mesh=self._partition_on_mesh,
-            vmapped_classes=(self.num_tree_per_iteration > 1
-                             and pool_slots == 0),
+            vmapped_classes=vmapped,
             batch_splits=batch_splits,
             batched_pack=(batch_splits > 0 and cfg.tpu_batched_pack),
             batched_part=batched_part,
@@ -913,11 +925,13 @@ class GBDT:
                                      feature_mask, params,
                                      forced=forced_splits, cegb=cs)
 
-            # class batching: vmap would turn the capped pool's
-            # rebuild-on-miss lax.cond into a both-branches select, paying
-            # a full rebuild every step — so k == 1 calls directly and a
-            # capped multiclass run maps classes sequentially (which also
-            # keeps one pool's worth of live memory, the point of the cap).
+            # class batching: k == 1 calls directly; multiclass maps
+            # classes sequentially when (a) the pool is capped — vmap
+            # would turn the rebuild-on-miss lax.cond into a both-branches
+            # select, and sequential keeps one pool's worth of live
+            # memory, the point of the cap — or (b) the backend is
+            # TPU-shaped, where sequential measured 1.9x faster than vmap
+            # even uncapped (round-4, tools/onchip_r4_results.json).
             # params.vmapped_classes is the ONE predicate: grow_tree keys
             # its sort-placement/pool decisions off the same flag this
             # dispatch uses, so the two can never disagree.

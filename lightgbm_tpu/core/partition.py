@@ -73,6 +73,16 @@ def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
     return jnp.stack([grad * m, hess * m, m], axis=1)
 
 
+def tpu_shaped_backend() -> bool:
+    """Allow-list backend sniff (tpu / the axon PJRT plugin), shared by
+    the sort-placement policy below and the GBDT multiclass
+    class-batching decision — an unknown plugin backend counts as NOT
+    TPU-shaped so untested backends keep the conservative paths."""
+    import jax
+    backend = jax.default_backend().lower()
+    return "tpu" in backend or "axon" in backend
+
+
 def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
     """Single policy for partition_and_hist's use_sort flag: the sort
     placement wins where scatters are latency-bound — measured on TPU only,
@@ -96,11 +106,7 @@ def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
                     "(use 0 or 1)" % ov)
     if hist_impl.startswith("pallas") and hist_impl.endswith("interpret"):
         return True
-    import jax
-    backend = jax.default_backend().lower()
-    # allow-list, not deny-list: an unknown plugin backend keeps the
-    # scatter loop too
-    return "tpu" in backend or "axon" in backend
+    return tpu_shaped_backend()
 
 
 def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
