@@ -84,14 +84,17 @@ def tpu_shaped_backend() -> bool:
 
 
 def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
-    """Single policy for partition_and_hist's use_sort flag: the sort
-    placement wins where scatters are latency-bound — measured on TPU only,
-    so the gate is TPU-shaped backends (including the axon PJRT plugin),
-    NOT every non-CPU backend: an untested GPU backend keeps the plain
-    scatter loop. ``LIGHTGBM_TPU_SORT_PLACEMENT=0/1`` overrides.
-    pallas_interpret opts in so CPU tests cover the branch, and vmapped
-    class-batched growth stays off it (lax.switch under vmap runs every
-    branch per split — legal, but a per-split performance cliff)."""
+    """Single policy for partition_and_hist's use_sort flag.
+
+    Round-4 on-chip re-measurement INVERTED the round-2 decision: at the
+    new auto row_chunk (4096; also at 8192/16384) the scatter loop beats
+    the single-trip sort placement on a v5e chip — 2.31 vs 1.97 iters/s
+    at the 1M x 28 bench shape (a 4096-key lax.sort per split costs more
+    than the scatter it replaced). Default is therefore OFF everywhere;
+    ``LIGHTGBM_TPU_SORT_PLACEMENT=1`` re-enables it for experiments, the
+    interpret spellings opt in so CPU tests keep covering the sort
+    branch, and vmapped class-batched growth can never use it
+    (lax.switch under vmap runs every branch per split)."""
     if vmapped:
         return False
     import os
@@ -104,9 +107,7 @@ def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
         from ..log import Log
         Log.warning("ignoring unrecognized LIGHTGBM_TPU_SORT_PLACEMENT=%r "
                     "(use 0 or 1)" % ov)
-    if hist_impl.startswith("pallas") and hist_impl.endswith("interpret"):
-        return True
-    return tpu_shaped_backend()
+    return hist_impl.startswith("pallas") and hist_impl.endswith("interpret")
 
 
 def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,

@@ -164,11 +164,13 @@ def test_pallas_highest_precision_matches_scatter_tighter():
 
 @pytest.mark.parametrize("fake_backend,plain_expected", [
     ("cpu", False), ("gpu", False), ("METAL", False), ("neuron", False),
-    ("tpu", True), ("axon", True)])
+    ("tpu", False), ("axon", False)])
 def test_sort_placement_gate_is_allow_list(monkeypatch, fake_backend,
                                            plain_expected):
-    """Sort placement was measured profitable on TPU only: unknown or GPU
-    backends must keep the scatter loop; env var overrides both ways."""
+    """Round-4 on-chip re-measurement: the scatter loop beats the sort
+    placement at the auto row_chunk even on TPU (2.31 vs 1.97 iters/s),
+    so the default is off EVERYWHERE; the env var overrides both ways
+    and interpret spellings opt in for CPU test coverage."""
     import jax
     from lightgbm_tpu.core import partition
     monkeypatch.delenv("LIGHTGBM_TPU_SORT_PLACEMENT", raising=False)
