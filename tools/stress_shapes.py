@@ -66,8 +66,14 @@ def run_shape(name, n, groups, card, extra_dense, iters, leaves):
         .astype(np.float32)
     print("%s: %d x %d, %.2f%% nnz" % (
         name, S.shape[0], S.shape[1], 100 * S.nnz / (S.shape[0] * S.shape[1])))
+    # STRESS_GROWTH overrides. Default batched: at these WIDE shapes the
+    # round-4 on-chip comparison favors batched (Expo 0.47 vs exact 0.55
+    # s/iter; Allstate 1.52 vs 1.93) — many stored columns make the
+    # per-split fused pass expensive, and batching amortizes it; the
+    # narrow HIGGS shape favors exact (docs/Performance.md).
+    growth = os.environ.get("STRESS_GROWTH", "batched")
     cfg = Config({"objective": "binary", "verbosity": 1,
-                  "num_leaves": leaves, "tree_growth": "batched",
+                  "num_leaves": leaves, "tree_growth": growth,
                   "tree_batch_splits": 16})
     t0 = time.time()
     ds = BinnedDataset.from_matrix(S, cfg, label=y)
@@ -85,9 +91,10 @@ def run_shape(name, n, groups, card, extra_dense, iters, leaves):
     b.train_many(iters)
     jax.block_until_ready(b.scores)
     dt = (time.time() - t0) / iters
-    print("%s train (%s, batched L=%d): %.2f s/iter "
+    print("%s train (%s, %s L=%d): %.2f s/iter "
           "(compile+%d iters: %.0fs)" % (
-              name, jax.default_backend(), leaves, dt, iters, compile_s))
+              name, jax.default_backend(), growth, leaves, dt, iters,
+              compile_s))
 
 
 def main():
