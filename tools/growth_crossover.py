@@ -1,0 +1,62 @@
+"""Find the exact-vs-batched crossover in stored-column count (round 4).
+
+HIGGS-narrow (28 cols) favors exact growth on chip; Expo/Allstate-wide
+favor batched. This sweeps dense shapes between them to locate the
+crossover that backs tree_growth=auto's policy. Appends results to
+tools/onchip_r4_results.json under "growth_crossover".
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+OUT = os.path.join(HERE, "onchip_r4_results.json")
+
+
+def main():
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+
+    n = 500_000
+    r = np.random.RandomState(0)
+    out = {}
+    for f in (28, 64, 128, 256):
+        X = r.randn(n, f).astype(np.float32)
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float32)
+        row = {}
+        for name, extra in (("exact", {"tree_growth": "exact"}),
+                            ("batched", {"tree_growth": "batched",
+                                         "tree_batch_splits": 32})):
+            cfg = Config({"objective": "binary", "num_leaves": 255,
+                          "verbosity": -1, **extra})
+            ds = BinnedDataset.from_matrix(X, cfg, label=y)
+            b = create_boosting(cfg, ds, create_objective(cfg), [])
+            b.train_many(3)
+            jax.block_until_ready(b.scores)
+            t0 = time.time()
+            b.train_many(6)
+            jax.block_until_ready(b.scores)
+            row[name] = round((time.time() - t0) / 6, 3)
+            del b, ds
+        row["winner"] = min(("exact", "batched"), key=row.get)
+        out["cols_%d" % f] = row
+        print(f, row, flush=True)
+
+    res = json.load(open(OUT))
+    res["growth_crossover"] = {"ok": True, "data": out,
+                               "shape": "500k rows, L=255, dense"}
+    with open(OUT + ".tmp", "w") as fh:
+        json.dump(res, fh, indent=1, sort_keys=True)
+    os.replace(OUT + ".tmp", OUT)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
