@@ -1,0 +1,100 @@
+"""Round-4 batched-growth kernel + routing units.
+
+Pins two things the end-to-end batched tests cannot isolate:
+- build_histogram_slots6 (parent-slot x 6-channel joint kernel) against
+  a per-slot numpy reference, including inactive rows and absent slots;
+- the dense one-hot routing (route_split_rows) on an EFB-BUNDLED
+  dataset under batched growth — the decode_bundle_value path rides
+  sel_k one-hot selects there, which no dense-data test exercises.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.boosting import create_boosting
+
+from conftest import make_binary
+from test_efb import _exclusive_groups
+
+
+def test_slots6_matches_per_slot_reference():
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.histogram_pallas import build_histogram_slots6
+
+    r = np.random.RandomState(11)
+    n, f, b, k = 5000, 6, 64, 4
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    slot = r.randint(-1, k, n).astype(np.int32)   # -1 = inactive
+    slot[slot == k - 1] = -1                      # leave slot k-1 ABSENT
+    sel = (r.rand(n) > 0.4).astype(np.float32)
+    vals = r.randn(3, n).astype(np.float32)
+    out = np.asarray(build_histogram_slots6(
+        jnp.asarray(xb), jnp.asarray(slot), jnp.asarray(sel),
+        jnp.asarray(vals), num_bins=b, n_slots=k, row_tile=512,
+        interpret=True))
+    assert out.shape == (k, f, b, 6)
+    for s in range(k):
+        m = slot == s
+        ref = np.zeros((f, b, 6), np.float32)
+        for ch in range(6):
+            w = sel[m] if ch < 3 else 1.0 - sel[m]
+            v = vals[ch % 3, m] * w
+            for j in range(f):
+                np.add.at(ref[j, :, ch], xb[m, j], v)
+        np.testing.assert_allclose(out[s], ref, rtol=5e-2, atol=5e-2)
+
+
+def _train(X, y, params, rounds=4):
+    cfg = Config(params)
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = cfg, ds
+    bst = create_boosting(cfg, ds, create_objective(cfg), [])
+    for _ in range(rounds):
+        bst.train_one_iter()
+    return bst, ds
+
+
+def test_batched_routing_on_efb_bundles():
+    """Batched growth over an EFB-bundled dataset: K=1 must reproduce
+    exact growth's split structure (the routing's decode_bundle_value
+    path through the one-hot selects), and K=4 must stay accurate."""
+    X, y = _exclusive_groups()
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5, "tpu_hist_impl": "scatter"}
+    be, ds_e = _train(X, y, dict(base, tree_growth="exact"))
+    assert ds_e.num_columns < X.shape[1], "test requires real bundling"
+    b1, _ = _train(X, y, dict(base, tree_growth="batched",
+                              tree_batch_splits=1))
+    for t0, t1 in zip(be.models, b1.models):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
+        np.testing.assert_array_equal(np.asarray(t0.threshold_bin),
+                                      np.asarray(t1.threshold_bin))
+    b4, _ = _train(X, y, dict(base, tree_growth="batched",
+                              tree_batch_splits=4))
+    p0 = be.predict(X[:400], raw_score=True)
+    p4 = b4.predict(X[:400], raw_score=True)
+    # different split ORDER is fine; the models must agree in quality
+    auc = lambda p: float(
+        (np.argsort(np.argsort(p))[y[:400] > 0].sum()
+         - (y[:400] > 0).sum() * ((y[:400] > 0).sum() + 1) / 2)
+        / max((y[:400] > 0).sum() * (400 - (y[:400] > 0).sum()), 1))
+    assert abs(auc(p0) - auc(p4)) < 0.05
+
+
+def test_batched_part_routing_on_efb_bundles():
+    """Same EFB routing contract for the partitioned batched grower
+    (shares route_split_rows, but its own layout maintenance)."""
+    X, y = _exclusive_groups()
+    base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5, "tpu_hist_impl": "scatter",
+            "tree_growth": "batched", "tree_batch_splits": 4}
+    b0, _ = _train(X, y, dict(base))
+    b1, _ = _train(X, y, dict(base, tpu_batched_part="true"))
+    for t0, t1 in zip(b0.models, b1.models):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
+        np.testing.assert_array_equal(np.asarray(t0.threshold_bin),
+                                      np.asarray(t1.threshold_bin))
