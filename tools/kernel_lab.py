@@ -353,3 +353,60 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+# ---------------------------------------------------------------------------
+# Round-4 feasibility probe for the partition-step mega-kernel (north-star
+# section of docs/Performance.md): IN-TILE stable partition as a
+# permutation one-hot matmul — EXACT for byte payloads (each output row is
+# one one-hot row of P times integer values <= 255; a single nonzero
+# product per output element, so no accumulation error), with the prefix
+# sum done as a lower-triangular f32 matvec (Mosaic has no cumsum).
+#
+# Measured on a v5e chip: ~8.8 ms per 1M x 128-byte-payload pass at
+# row_tile 256/512 (per-tile-overhead bound — the skinny [1, t] prefix
+# matvec and per-tile setup dominate, not the P @ data matmul), exact
+# output, per-tile left-counts delivered in an i32 side output.
+#
+# Mosaic lowering gotchas hit on the way (all worked around below):
+#   - uint8 -> bfloat16 casts unsupported (go via int32);
+#   - jnp.cumsum unsupported (triangular matmul instead);
+#   - f32 iota unsupported (int iota + cast);
+#   - scalar extraction like cl[-1] lowers to dynamic_slice (unsupported)
+#     — keep everything 2D and use keepdims reductions.
+def partition_tile_kernel(xb_ref, gl_ref, out_ref, cnt_ref):
+    xb = xb_ref[...].astype(jnp.int32).astype(jnp.bfloat16)   # [t, C]
+    gl2 = gl_ref[...]                                         # [1, t] f32
+    t = xb.shape[0]
+    iota0 = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    iota1 = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    ut = jnp.where(iota1 <= iota0, 1.0, 0.0)
+    cl2 = jax.lax.dot_general(gl2, ut, (((1,), (1,)), ((), ())),
+                              precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32)  # [1, t]
+    nl2 = jnp.sum(gl2, axis=1, keepdims=True)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (1, t), 1).astype(jnp.float32)
+    pos2 = jnp.where(gl2 > 0, cl2 - 1.0, nl2 + (ii + 1.0) - cl2 - 1.0)
+    perm = jnp.where(iota0 == pos2.astype(jnp.int32), 1.0, 0.0) \
+        .astype(jnp.bfloat16)
+    out = jax.lax.dot_general(perm, xb, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out_ref[...] = out.astype(jnp.int32).astype(jnp.uint8)
+    cnt_ref[...] = jnp.broadcast_to(nl2, cnt_ref.shape).astype(jnp.int32)
+
+
+def mk_partition_tiles(n, c, row_tile):
+    @jax.jit
+    def run(xb, gl):
+        return pl.pallas_call(
+            partition_tile_kernel,
+            grid=(n // row_tile,),
+            in_specs=[pl.BlockSpec((row_tile, c), lambda r: (r, 0)),
+                      pl.BlockSpec((1, row_tile), lambda r: (0, r))],
+            out_specs=[pl.BlockSpec((row_tile, c), lambda r: (r, 0)),
+                       pl.BlockSpec((8, 128), lambda r: (r, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, c), jnp.uint8),
+                       jax.ShapeDtypeStruct((n // row_tile * 8, 128),
+                                            jnp.int32)],
+        )(xb, gl)
+    return run
