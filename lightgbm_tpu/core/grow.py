@@ -37,8 +37,9 @@ from jax import lax
 
 from .histogram import build_histogram
 from .partition import (RowPartition, hist_for_leaf, init_partition,
-                        leaf_id_from_partition, partition_and_hist,
-                        sort_placement_profitable, stack_vals)
+                        leaf_id_from_partition, make_row_gather,
+                        partition_and_hist, sort_placement_profitable,
+                        stack_vals)
 from .split import (BestSplit, FeatureMeta, SplitParams, K_EPSILON,
                     K_MIN_SCORE, MISSING_NAN, MISSING_NONE, MISSING_ZERO,
                     calculate_leaf_output, find_best_split, leaf_split_gain,
@@ -564,7 +565,13 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     sample_mask = sample_mask.astype(hdt)
     grad = grad.astype(hdt)
     hess = hess.astype(hdt)
-    vals3 = stack_vals(grad, hess, sample_mask) if use_partition else None
+    # bins + value channels behind one gather closure: packed single-gather
+    # rows on the normal path; two gathers under vmapped class batching,
+    # where packing would copy the shared bin matrix per class
+    # (make_row_gather docstring)
+    gather_rows = (make_row_gather(xb, stack_vals(grad, hess, sample_mask),
+                                   packed=not params.vmapped_classes)
+                   if use_partition else None)
     root_g = psum(jnp.sum(grad * sample_mask))
     root_h = psum(jnp.sum(hess * sample_mask))
     root_c = psum(jnp.sum(sample_mask))
@@ -621,15 +628,19 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 # count on dead iterations, so they rebuild 0 rows and
                 # psum zeros) — this is what lets forced splits ride the
                 # fused sharded partition path at all
-                return psum(hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
+                return psum(hist_for_leaf(s.part, leaf_idx, gather_rows,
+                                          n, ncols, b,
                                           params.row_chunk, valid=live,
-                                          impl=params.hist_impl))
+                                          impl=params.hist_impl,
+                                          val_dtype=hdt))
             # single device: dead iterations never pay for a rebuild
             return lax.cond(
                 live,
-                lambda _: hist_for_leaf(s.part, leaf_idx, xb, vals3, b,
+                lambda _: hist_for_leaf(s.part, leaf_idx, gather_rows,
+                                        n, ncols, b,
                                         params.row_chunk, valid=True,
-                                        impl=params.hist_impl),
+                                        impl=params.hist_impl,
+                                        val_dtype=hdt),
                 lambda _: jnp.zeros((ncols_h, b, 3), hdt),
                 operand=None)
         if not capped:
@@ -775,8 +786,9 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                                  params.vmapped_classes)
             part, leaf_id, hist_left_d, hist_right_d = partition_and_hist(
                 s.part, s.leaf_id, leaf, right_leaf, go_left_rows, valid,
-                params.row_chunk, xb, vals3, b, params.hist_impl,
-                maintain_leaf_id=maintain_lid, use_sort=use_sort)
+                params.row_chunk, gather_rows, ncols, b, params.hist_impl,
+                maintain_leaf_id=maintain_lid, use_sort=use_sort,
+                val_dtype=hdt)
             if axis_name is not None:
                 # one collective per split: psum the fused 6-channel
                 # accumulator, not the two child views separately

@@ -73,6 +73,40 @@ def stack_vals(grad: jnp.ndarray, hess: jnp.ndarray,
     return jnp.stack([grad * m, hess * m, m], axis=1)
 
 
+def make_row_gather(xb: jnp.ndarray, vals: jnp.ndarray,
+                    packed: bool = True):
+    """Build the per-tile ``gather_rows(idx_safe) -> (rows, v)`` closure
+    the partition loops use, owning the bins/values layout in ONE place.
+
+    packed=True bit-packs [N, C] uint8 bins and [N, 3] float values side
+    by side into one [N, C + 3*itemsize] uint8 array, so a histogram
+    trip does ONE row gather instead of two (round-4 measurement: trip
+    cost is bound by the NUMBER of indexed ops, not the bytes they
+    move); the per-tile unpack is a free bitcast. packed=False keeps
+    two gathers — required under vmapped class-batched growth, where
+    the concat would materialize a PER-CLASS copy of the shared bin
+    matrix."""
+    if not packed:
+        def gather_rows(idx_safe):
+            rows = xb.at[idx_safe].get(mode="promise_in_bounds")
+            v = vals.at[idx_safe].get(mode="promise_in_bounds")
+            return rows, v
+        return gather_rows
+    n, c = xb.shape
+    nbytes = jnp.dtype(vals.dtype).itemsize
+    vb = lax.bitcast_convert_type(vals, jnp.uint8).reshape(n, -1)
+    xv = jnp.concatenate([xb, vb], axis=1)
+    val_dtype = vals.dtype
+
+    def gather_rows(idx_safe):
+        p = xv.at[idx_safe].get(mode="promise_in_bounds")
+        rows = p[:, :c]
+        v = lax.bitcast_convert_type(
+            p[:, c:].reshape(p.shape[0], 3, nbytes), val_dtype)
+        return rows, v
+    return gather_rows
+
+
 def tpu_shaped_backend() -> bool:
     """Allow-list backend sniff (tpu / the axon PJRT plugin), shared by
     the sort-placement policy below and the GBDT multiclass
@@ -112,9 +146,9 @@ def sort_placement_profitable(hist_impl: str, vmapped: bool) -> bool:
 
 def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
                        go_left_from_rows, valid, chunk: int,
-                       xb: jnp.ndarray, vals: jnp.ndarray, num_bins: int,
+                       gather_rows, num_cols: int, num_bins: int,
                        impl: str, maintain_leaf_id: bool = False,
-                       use_sort: bool = False):
+                       use_sort: bool = False, val_dtype=jnp.float32):
     """One pass over ``leaf``'s rows that BOTH partitions the range and
     builds both children's [F, B, 3] histograms.
 
@@ -127,33 +161,36 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
     what actually dominates on TPU (see module docstring).
 
     ``go_left_from_rows(rows[chunk, F]) -> bool[chunk]`` evaluates the split
-    decision directly on the gathered feature bytes. ``use_sort`` selects
-    the single-trip sort placement (TPU-profitable; keep it off under vmap
-    — the batching rule for lax.switch lowers to a select that runs every
-    branch per split, semantically fine but a performance cliff).
+    decision directly on the gathered feature bytes. ``gather_rows`` is a
+    make_row_gather() closure owning the bins+values layout (packed:
+    ONE row gather per tile serves both the routing bytes and the value
+    channels). ``use_sort`` selects the single-trip sort placement (keep
+    it off under vmap — the batching rule for lax.switch lowers to a
+    select that runs every branch per split, semantically fine but a
+    performance cliff).
 
     Returns (new_part, new_leaf_id, hist_left, hist_right).
     """
     n_rows = leaf_id.shape[0]
-    f = xb.shape[1]
+    f = num_cols
     order_len = part.order.shape[0]
     trash = order_len - 1                  # never inside any leaf range
     beg = part.leaf_begin[leaf]
     cnt = jnp.where(valid, part.leaf_count[leaf], 0)
 
     def load_tile(start, in_range):
-        """Shared tile load: gather rows + values, decide the split, weight
-        the six child channels, add the histogram tile."""
+        """Shared tile load: gather the tile's bins+values rows, decide
+        the split, weight the six child channels, add the histogram
+        tile."""
         idx = lax.dynamic_slice(part.order, (start,), (chunk,))
         idx_safe = jnp.minimum(idx, n_rows - 1)
-        rows = xb.at[idx_safe].get(mode="promise_in_bounds")   # [chunk, F]
-        v = vals.at[idx_safe].get(mode="promise_in_bounds") \
-            * in_range[:, None].astype(vals.dtype)             # [chunk, 3]
+        rows, v = gather_rows(idx_safe)                        # [chunk, F/3]
+        v = v * in_range[:, None].astype(v.dtype)
         go_left = go_left_from_rows(rows)
         is_l = go_left & in_range
         is_r = (~go_left) & in_range
-        v6 = jnp.concatenate([v * is_l[:, None].astype(vals.dtype),
-                              v * is_r[:, None].astype(vals.dtype)],
+        v6 = jnp.concatenate([v * is_l[:, None].astype(v.dtype),
+                              v * is_r[:, None].astype(v.dtype)],
                              axis=1)                           # [chunk, 6]
         hist = hist_tile_vals(rows, v6, num_bins, impl)
         return idx, idx_safe, go_left, is_l, is_r, hist
@@ -193,7 +230,7 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
 
     def multi_trip(_):
         init = (jnp.int32(0), jnp.int32(0), jnp.int32(0), part.order,
-                leaf_id, jnp.zeros((f, num_bins, 6), vals.dtype))
+                leaf_id, jnp.zeros((f, num_bins, 6), val_dtype))
         _, nl, nr, order_new, lid, acc = lax.while_loop(cond, body, init)
         return order_new, lid, nl, nr, acc
 
@@ -224,7 +261,7 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
 
         def dead(_):
             return (part.order, leaf_id, jnp.int32(0), jnp.int32(0),
-                    jnp.zeros((f, num_bins, 6), vals.dtype))
+                    jnp.zeros((f, num_bins, 6), val_dtype))
 
         which = jnp.where(cnt == 0, 0, jnp.where(cnt <= chunk, 1, 2))
         order_new, leaf_id, n_left, n_right, acc6 = lax.switch(
@@ -240,17 +277,17 @@ def partition_and_hist(part: RowPartition, leaf_id, leaf, right_leaf,
             acc6[:, :, :3], acc6[:, :, 3:])
 
 
-def hist_for_leaf(part: RowPartition, leaf, xb: jnp.ndarray,
-                  vals: jnp.ndarray, num_bins: int, chunk: int, valid=True,
-                  impl: str = "matmul") -> jnp.ndarray:
+def hist_for_leaf(part: RowPartition, leaf, gather_rows, num_rows: int,
+                  num_cols: int, num_bins: int, chunk: int, valid=True,
+                  impl: str = "matmul",
+                  val_dtype=jnp.float32) -> jnp.ndarray:
     """Build [F, B, 3] (grad, hess, count) histograms over one leaf's rows.
 
     Touches ceil(leaf_count / chunk) fixed-size tiles: row ids come from a
-    contiguous slice of ``order``; feature bytes and the stacked [N, 3]
-    ``vals`` (see stack_vals) are gathered once per tile.
+    contiguous slice of ``order``; ``gather_rows`` (make_row_gather) loads
+    each tile's bins+values — one gather when packed.
     """
-    n_rows = xb.shape[0]
-    f = xb.shape[1]
+    f = num_cols
     beg = part.leaf_begin[leaf]
     cnt = jnp.where(valid, part.leaf_count[leaf], 0)
 
@@ -264,14 +301,13 @@ def hist_for_leaf(part: RowPartition, leaf, xb: jnp.ndarray,
         idx = lax.dynamic_slice(part.order, (start,), (chunk,))
         j = jnp.arange(chunk, dtype=jnp.int32)
         in_range = (i * chunk + j) < cnt
-        idx_safe = jnp.minimum(jnp.where(in_range, idx, 0), n_rows - 1)
-        rows = xb.at[idx_safe].get(mode="promise_in_bounds")   # [chunk, F]
-        v = vals.at[idx_safe].get(mode="promise_in_bounds") \
-            * in_range[:, None].astype(vals.dtype)             # [chunk, 3]
+        idx_safe = jnp.minimum(jnp.where(in_range, idx, 0), num_rows - 1)
+        rows, v = gather_rows(idx_safe)                        # [chunk, F/3]
+        v = v * in_range[:, None].astype(v.dtype)
         return i + 1, acc + hist_tile_vals(rows, v, num_bins, impl)
 
     _, hist = lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.zeros((f, num_bins, 3), vals.dtype)))
+        cond, body, (jnp.int32(0), jnp.zeros((f, num_bins, 3), val_dtype)))
     return hist
 
 

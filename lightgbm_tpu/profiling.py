@@ -32,7 +32,7 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     partition_hist_fused, hist_leaf_half, find_split."""
     from .core.histogram import build_histogram
     from .core.partition import (hist_for_leaf, init_partition,
-                                 partition_and_hist,
+                                 make_row_gather, partition_and_hist,
                                  sort_placement_profitable, stack_vals)
     from .core.split import find_best_split
 
@@ -70,7 +70,12 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
 
         part = init_partition(n, params.num_leaves, params.row_chunk)
         half = jnp.asarray(np.arange(n, dtype=np.int64) % 2 == 0)
-        vals3 = stack_vals(g, h, mask)
+        # probe in f32 regardless of ambient x64: the gather closure owns
+        # the packed bins/values boundary, so dtypes must be consistent
+        gr = make_row_gather(
+            xb, stack_vals(g.astype(jnp.float32), h.astype(jnp.float32),
+                           mask.astype(jnp.float32)))
+        ncols = xb.shape[1]
         # the real growth path: one fused pass that partitions the root and
         # prices both children — same placement selection as grow_tree
         # (sort path on device / pallas_interpret, scatter loop on CPU)
@@ -79,13 +84,13 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
         fused = jax.jit(lambda p: partition_and_hist(
             p, jnp.zeros((n,), jnp.int32), jnp.int32(0), jnp.int32(1),
             lambda rows: half[:rows.shape[0]],
-            jnp.asarray(True), params.row_chunk, xb, vals3,
+            jnp.asarray(True), params.row_chunk, gr, ncols,
             params.num_bins, params.hist_impl, use_sort=use_sort))
         out["partition_hist_fused"] = _timed(lambda p: fused(p)[0], part)
         part2 = fused(part)[0]
         out["hist_leaf_half"] = _timed(
             jax.jit(lambda p: hist_for_leaf(
-                p, jnp.int32(0), xb, vals3, params.num_bins,
+                p, jnp.int32(0), gr, n, ncols, params.num_bins,
                 params.row_chunk, impl=params.hist_impl)), part2)
 
         sum_g = jnp.sum(g)

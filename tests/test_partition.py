@@ -72,7 +72,7 @@ def test_partition_matches_masked(num_leaves, chunk):
 def test_partition_leaf_counts_consistent():
     """Partition bookkeeping: leaf ranges tile [0, N) and counts match the
     per-row leaf_id assignment."""
-    from lightgbm_tpu.core.partition import (init_partition,
+    from lightgbm_tpu.core.partition import (init_partition, make_row_gather,
                                              partition_and_hist, stack_vals)
 
     np.random.seed(4)
@@ -87,12 +87,13 @@ def test_partition_leaf_counts_consistent():
     xb[:, 0] = decision_np.astype(np.uint8)
     vals = stack_vals(jnp.asarray(np.random.randn(n).astype(np.float32)),
                       jnp.ones((n,), jnp.float32), jnp.ones((n,), jnp.float32))
+    gr = make_row_gather(jnp.asarray(xb), vals)
 
     part, leaf_id, hl, hr = jax.jit(
         lambda p, l: partition_and_hist(
             p, l, jnp.int32(0), jnp.int32(1),
             lambda rows: rows[:, 0] == 1,
-            jnp.asarray(True), chunk, jnp.asarray(xb), vals, b,
+            jnp.asarray(True), chunk, gr, f, b,
             "scatter", maintain_leaf_id=True))(part, leaf_id)
     # the fused histograms cover exactly each child's rows
     assert int(np.asarray(hl)[0, 1, 2]) == int(decision_np.sum())
