@@ -17,8 +17,10 @@ regardless of size up to ~64k elements, while dense full-array ops run at
 memory bandwidth. The layout below therefore minimizes the NUMBER of
 indexed ops per split rather than the elements they touch:
 
-- per-row values ride in one stacked [N, 3] f32 array (grad*mask, hess*mask,
-  mask), so a histogram trip does ONE row gather + ONE value gather;
+- per-row bins AND values ride behind one make_row_gather closure —
+  bit-packed side by side on the normal path, so a histogram trip does
+  ONE row gather total (two only under vmapped class batching, where
+  packing would copy the shared bin matrix per class);
 - every gather/scatter is annotated promise-in-bounds (indices are clamped
   or routed to the trash slot first);
 - ``leaf_id`` is NOT maintained per split — it is reconstructed once per
