@@ -1,0 +1,29 @@
+"""In-tile partition kernel (core/repack_pallas.py) — the proven phase-1
+primitive of the partition-step mega-kernel plan (docs/Performance.md
+north-star section). Byte payloads must come back EXACT (every output
+element is a single one-hot product), with correct per-tile left counts,
+under skewed and degenerate left/right mixes."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.core.repack_pallas import partition_tiles
+
+
+@pytest.mark.parametrize("p_left", [0.0, 0.3, 1.0])
+def test_partition_tiles_exact(p_left):
+    r = np.random.RandomState(5)
+    n, c, tile = 2048, 128, 256
+    rows = r.randint(0, 256, (n, c)).astype(np.uint8)
+    gl = (r.rand(n) < p_left)
+    out, cnt = partition_tiles(jnp.asarray(rows), jnp.asarray(gl),
+                               row_tile=tile, interpret=True)
+    out, cnt = np.asarray(out), np.asarray(cnt)
+    assert cnt.shape == (n // tile,)
+    for t in range(n // tile):
+        sl = slice(t * tile, (t + 1) * tile)
+        g = gl[sl]
+        ref = np.concatenate([rows[sl][g], rows[sl][~g]])
+        np.testing.assert_array_equal(out[sl], ref)
+        assert cnt[t] == int(g.sum())
