@@ -90,11 +90,12 @@ def route_split_rows(xb_fm, rank, rs, onek, cur, meta, with_efb,
                   else cur.feature).astype(jnp.int32)        # [kb]
     cols = xb_fm[stored_col, :].astype(jnp.int32)            # [kb, N]
     colv = jnp.sum(jnp.where(onek, cols, 0), axis=0)         # [N]
+    num_bin_r = sel_k(meta.num_bin[cur.feature])
+    default_bin_r = sel_k(meta.default_bin[cur.feature])
     if with_efb:
         fbin = decode_bundle_value(
             colv, sel_k(meta.offset[cur.feature]),
-            sel_k(meta.num_bin[cur.feature]),
-            sel_k(meta.default_bin[cur.feature]),
+            num_bin_r, default_bin_r,
             pack_div=(sel_k(meta.pack_div[cur.feature])
                       if meta.pack_div is not None else None),
             pack_mod=(sel_k(meta.pack_mod[cur.feature])
@@ -104,8 +105,7 @@ def route_split_rows(xb_fm, rank, rs, onek, cur, meta, with_efb,
     return _bin_go_left(
         fbin, sel_k(cur.threshold), sel_k(cur.default_left),
         sel_k(meta.missing_type[cur.feature]),
-        sel_k(meta.num_bin[cur.feature]),
-        sel_k(meta.default_bin[cur.feature]),
+        num_bin_r, default_bin_r,
         (cur.is_categorical[rs] if with_categorical else None),
         (cur.cat_bitset[rs] if with_categorical else None))
 

@@ -39,6 +39,29 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
+def _digit_contract(a, eq, highest: bool):
+    """Shared MXU contraction of every digit kernel in this file:
+    [M, C] values-by-digit LHS against a [Nw, C] one-hot RHS, contracted
+    over rows. ``highest`` keeps full f32 (the gpu_use_dp analog, ~2x
+    MXU cost); the default splits the values operand into two bfloat16
+    terms — the one-hot side is exactly representable, so two
+    default-precision passes land within ~3e-6 of f32."""
+    if highest:
+        return jax.lax.dot_general(
+            a, eq, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+    a_top = a.astype(jnp.bfloat16)
+    a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
+    eqb = eq.astype(jnp.bfloat16)
+    part = jax.lax.dot_general(
+        a_top, eqb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return part + jax.lax.dot_general(
+        a_rem, eqb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int, highest: bool):
     """One (feature_tile, row_tile) grid cell.
 
@@ -69,28 +92,11 @@ def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int, highest: bool):
         lo_eq = iota_lo == (x & 15)                          # [16, C]
         a = jnp.where(hi_eq[None, :, :], vals[:, None, :],
                       0.0).reshape(k * hi_n, c)              # [K*Hi, C]
-        if highest:
-            eqlo = jnp.where(lo_eq, 1.0, 0.0)
-            part = jax.lax.dot_general(
-                a, eqlo, (((1,), (1,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32)          # [K*Hi, 16]
-        else:
-            # two-term bf16 split of the values operand; the one-hot operand
-            # is exactly representable, so two default-precision MXU passes
-            # land within ~3e-6 of a full-f32 contraction
-            a_top = a.astype(jnp.bfloat16)
-            a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
-            # NB: build the one-hot in f32 and downcast — a direct bf16
-            # select on the i1 mask trips a Mosaic relayout bug on this
-            # toolchain
-            eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
-            part = jax.lax.dot_general(
-                a_top, eqlo, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [K*Hi, 16]
-            part += jax.lax.dot_general(
-                a_rem, eqlo, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
+        # NB: build the one-hot in f32 and let _digit_contract downcast —
+        # a direct bf16 select on the i1 mask trips a Mosaic relayout bug
+        # on this toolchain
+        eqlo = jnp.where(lo_eq, 1.0, 0.0)
+        part = _digit_contract(a, eqlo, highest)             # [K*Hi, 16]
         out_ref[:, j, :, :] += part.reshape(k, hi_n, 16)
 
 
@@ -188,21 +194,7 @@ def _hist_slot6_kernel(xb_ref, slot_ref, sel_ref, vals_ref, out_ref, *,
                           0.0).reshape(6 * hi_n, c)          # [6*Hi, C]
             eqj = jnp.where(s_eq[:, None, :] & lo_eq[None, :, :], 1.0,
                             0.0).reshape(n_slots * 16, c)    # [S*16, C]
-            if highest:
-                part = jax.lax.dot_general(
-                    a, eqj, (((1,), (1,)), ((), ())),
-                    precision=jax.lax.Precision.HIGHEST,
-                    preferred_element_type=jnp.float32)
-            else:
-                a_top = a.astype(jnp.bfloat16)
-                a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
-                eqb = eqj.astype(jnp.bfloat16)
-                part = jax.lax.dot_general(
-                    a_top, eqb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                part += jax.lax.dot_general(
-                    a_rem, eqb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+            part = _digit_contract(a, eqj, highest)
             out_ref[:, j, :, :] += part.reshape(6, hi_n, n_slots * 16)
 
 
@@ -310,22 +302,8 @@ def _hist_part_kernel(tile_slot_ref, tile_first_ref, xb_ref, sel_ref,
             lo_eq = iota_lo == (x & 15)                      # [16, C]
             a = jnp.where(hi_eq[None, :, :], v6[:, None, :],
                           0.0).reshape(6 * hi_n, c)          # [6*Hi, C]
-            if highest:
-                eqlo = jnp.where(lo_eq, 1.0, 0.0)
-                part = jax.lax.dot_general(
-                    a, eqlo, (((1,), (1,)), ((), ())),
-                    precision=jax.lax.Precision.HIGHEST,
-                    preferred_element_type=jnp.float32)      # [6*Hi, 16]
-            else:
-                a_top = a.astype(jnp.bfloat16)
-                a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
-                eqlo = jnp.where(lo_eq, 1.0, 0.0).astype(jnp.bfloat16)
-                part = jax.lax.dot_general(
-                    a_top, eqlo, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                part += jax.lax.dot_general(
-                    a_rem, eqlo, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+            eqlo = jnp.where(lo_eq, 1.0, 0.0)
+            part = _digit_contract(a, eqlo, highest)         # [6*Hi, 16]
             out_ref[0, :, j, :, :] += part.reshape(6, hi_n, 16)
 
 
@@ -446,21 +424,7 @@ def _hist_slot_tile(xb_ref, slot, vals, out_ref, *, hi_n, n_slots, highest,
         # RHS one-hot of (slot, lo) jointly: column index s*16 + lo
         eqj = jnp.where(s_eq[:, None, :] & lo_eq[None, :, :], 1.0,
                         0.0).reshape(n_slots * 16, c)        # [S*16, C]
-        if highest:
-            part = jax.lax.dot_general(
-                a, eqj, (((1,), (1,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32)          # [K*Hi, S*16]
-        else:
-            a_top = a.astype(jnp.bfloat16)
-            a_rem = (a - a_top.astype(jnp.float32)).astype(jnp.bfloat16)
-            eqj = eqj.astype(jnp.bfloat16)
-            part = jax.lax.dot_general(
-                a_top, eqj, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            part += jax.lax.dot_general(
-                a_rem, eqj, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
+        part = _digit_contract(a, eqj, highest)              # [K*Hi, S*16]
         out_ref[:, j, :, :] += part.reshape(k, hi_n, n_slots * 16)
 
 

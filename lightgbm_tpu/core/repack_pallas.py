@@ -65,6 +65,9 @@ def partition_tiles(rows: jnp.ndarray, go_left: jnp.ndarray,
     assert c % 128 == 0, "payload width must be lane-aligned (pad to 128)"
     t = n // row_tile
     gl = go_left.astype(jnp.float32)[None, :]
+    # the count side-output is one scalar per tile, but Mosaic's minimum
+    # block is (8, 128) — each tile broadcasts its count over one such
+    # block and the [::8, 0] stride reads the scalars back out
     out, cnt = pl.pallas_call(
         _partition_tile_kernel,
         grid=(t,),
