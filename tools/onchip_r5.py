@@ -1,0 +1,208 @@
+"""Round-5 start-of-round on-chip validation in ONE command.
+
+Run this THE MOMENT hardware answers, before feature work (the round-4
+lesson: every CPU-proxied perf decision inverted on chip, and the
+tunnel dies unpredictably — front-load hardware truth). Appends each
+result to ``tools/onchip_r5_results.json`` as it lands; rerun resumes.
+
+    python tools/onchip_r5.py [--redo]
+
+Steps:
+  probe            backend + matmul sanity (also detects degraded-tunnel
+                   states: round 4 saw ~6x all-workload slowdowns and
+                   multi-hour hangs — compare against ~0.1-1 ms/matmul)
+  kernel_parity    ALL Pallas kernels vs scatter references ON HARDWARE:
+                   base digit kernel, slots6, part-tiles, repack
+                   partition_tiles (the round-4 refactor shares
+                   _digit_contract; this revalidates the compiled forms)
+  bench_default    bench.py as the driver runs it (exact growth, packed
+                   single-gather, rc auto) — expect ~2.3-2.6 raw on a
+                   healthy v5e, ~0.4 in a degraded window
+  bench_batched    BENCH_TREE_GROWTH=batched (K=32) comparison point
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(HERE, "onchip_r5_results.json")
+
+
+def load():
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            return json.load(f)
+    return {}
+
+
+def save(results):
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1, sort_keys=True)
+    os.replace(tmp, OUT)
+
+
+def run_step(name, code_or_cmd, results, timeout, env=None, redo=False):
+    if name in results and not redo and results[name].get("ok"):
+        print("[skip] %s (already recorded)" % name, flush=True)
+        return True
+    print("[run ] %s (timeout %ds)" % (name, timeout), flush=True)
+    t0 = time.time()
+    cmd = code_or_cmd if isinstance(code_or_cmd, list) \
+        else [sys.executable, "-c", code_or_cmd]
+    full_env = dict(os.environ, **(env or {}))
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO, env=full_env)
+        rec = {"ok": r.returncode == 0, "seconds": round(time.time() - t0, 1)}
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("RESULT:"):
+                rec["data"] = json.loads(line[len("RESULT:"):])
+            elif line.startswith("{") and line.rstrip().endswith("}"):
+                try:
+                    rec["data"] = json.loads(line)
+                except ValueError:
+                    pass
+        if r.returncode != 0:
+            rec["error"] = (r.stderr or r.stdout or "")[-800:]
+    except subprocess.TimeoutExpired:
+        rec = {"ok": False, "seconds": round(time.time() - t0, 1),
+               "error": "timeout after %ds" % timeout}
+    results[name] = rec
+    save(results)
+    print("[%s] %s %s" % ("ok  " if rec["ok"] else "FAIL", name,
+                          rec.get("data", rec.get("error", ""))), flush=True)
+    return rec["ok"]
+
+
+PROBE = r"""
+import json, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((4096, 4096), jnp.bfloat16)
+t1 = time.time(); y = (x @ x).block_until_ready(); t2 = time.time()
+for _ in range(5):
+    y = (x @ x).block_until_ready()
+t3 = time.time()
+print("RESULT:" + json.dumps({
+    "platform": d[0].platform, "kind": str(getattr(d[0], "device_kind", "?")),
+    "init_s": round(t1 - t0, 1),
+    "matmul_ms": round((t3 - t2) / 5 * 1000, 2)}))
+"""
+
+KERNEL_PARITY = r"""
+import json
+import numpy as np
+import jax.numpy as jnp
+from lightgbm_tpu.core.histogram import build_histogram
+from lightgbm_tpu.core.histogram_pallas import (build_histogram_slots6,
+                                               build_histogram_part_tiles)
+from lightgbm_tpu.core.repack_pallas import partition_tiles
+r = np.random.RandomState(7)
+n, f, b = 65536, 28, 256
+xb = r.randint(0, b, (n, f)).astype(np.uint8)
+g = r.randn(n).astype(np.float32)
+h = np.abs(r.randn(n)).astype(np.float32)
+m = (r.rand(n) > 0.3).astype(np.float32)
+out = {}
+ref = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                 jnp.asarray(h), jnp.asarray(m),
+                                 num_bins=b, impl="scatter"))
+pal = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                 jnp.asarray(h), jnp.asarray(m),
+                                 num_bins=b, impl="pallas"))
+out["base_vs_scatter_max"] = float(np.abs(pal - ref).max())
+hi = np.asarray(build_histogram(jnp.asarray(xb), jnp.asarray(g),
+                                jnp.asarray(h), jnp.asarray(m),
+                                num_bins=b, impl="pallas_highest"))
+out["highest_vs_scatter_max"] = float(np.abs(hi - ref).max())
+# slots6: K parent slots + go-left selector -> both children's channels
+K = 8
+slot = r.randint(-1, K, n).astype(np.int32)
+sel = (r.rand(n) > 0.5).astype(np.float32)
+vals = np.stack([g * m, h * m, m])
+s6 = np.asarray(build_histogram_slots6(
+    jnp.asarray(xb), jnp.asarray(slot), jnp.asarray(sel),
+    jnp.asarray(vals), num_bins=b, n_slots=K))
+err = 0.0
+for s in range(K):
+    msk = slot == s
+    for ch in range(6):
+        w = sel[msk] if ch < 3 else 1 - sel[msk]
+        v = vals[ch % 3, msk] * w
+        refc = np.zeros((f, b), np.float32)
+        for j in range(f):
+            np.add.at(refc[j], xb[msk, j], v)
+        err = max(err, float(np.abs(s6[s, :, :, ch] - refc).max()))
+out["slots6_vs_scatter_max"] = err
+# part-tiles: tile-pure segments
+tile = 2048
+T = n // tile
+ts = np.full(T, -1, np.int32); ts[: T // 2] = np.arange(T // 2) % 4
+tf = np.zeros(T, np.int32)
+for t in range(T // 2):
+    tf[t] = 1 if t == 0 or ts[t] != ts[t - 1] else 0
+vals_pt = vals.copy(); vals_pt[:, (T // 2) * tile:] = 0.0
+pt = np.asarray(build_histogram_part_tiles(
+    jnp.asarray(np.ascontiguousarray(xb.T)), jnp.asarray(sel),
+    jnp.asarray(vals_pt), jnp.asarray(ts), jnp.asarray(tf),
+    num_bins=b, n_slots=4))
+err = 0.0
+for s in range(4):
+    rows = np.concatenate([np.arange(t * tile, (t + 1) * tile)
+                           for t in range(T // 2) if ts[t] == s])
+    for ch in range(6):
+        w = sel[rows] if ch < 3 else 1 - sel[rows]
+        v = vals_pt[ch % 3, rows] * w
+        refc = np.zeros((f, b), np.float32)
+        for j in range(f):
+            np.add.at(refc[j], xb[rows, j], v)
+        err = max(err, float(np.abs(pt[s, :, :, ch] - refc).max()))
+out["part_tiles_vs_scatter_max"] = err
+# repack: exact in-tile partition
+rows128 = r.randint(0, 256, (8192, 128)).astype(np.uint8)
+gl = r.rand(8192) < 0.4
+o, cnt = partition_tiles(jnp.asarray(rows128), jnp.asarray(gl),
+                         row_tile=512)
+o = np.asarray(o)
+ok = True
+for t in range(16):
+    sl = slice(t * 512, (t + 1) * 512)
+    gg = gl[sl]
+    ok = ok and np.array_equal(
+        o[sl], np.concatenate([rows128[sl][gg], rows128[sl][~gg]])) \
+        and int(cnt[t]) == int(gg.sum())
+out["repack_exact"] = bool(ok)
+print("RESULT:" + json.dumps(out))
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--redo", action="store_true")
+    args = ap.parse_args()
+    results = load()
+    redo = args.redo
+
+    if not run_step("probe", PROBE, results, timeout=360, redo=redo):
+        print("backend unreachable — stopping (results preserved)")
+        return 1
+    run_step("kernel_parity", KERNEL_PARITY, results, timeout=900,
+             redo=redo)
+    bench_env = {"BENCH_BACKEND_TRIES": "1", "BENCH_BACKEND_TIMEOUT": "240"}
+    run_step("bench_default", [sys.executable, "bench.py"], results,
+             timeout=1800, env=bench_env, redo=redo)
+    run_step("bench_batched", [sys.executable, "bench.py"], results,
+             timeout=1800,
+             env=dict(bench_env, BENCH_TREE_GROWTH="batched"), redo=redo)
+    print("\nall recorded in", OUT)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
