@@ -54,8 +54,11 @@ def _probe_backend(timeout_s: float) -> dict:
 
 def _select_backend() -> dict:
     """Probe the ambient (TPU) backend with retries; fall back to CPU."""
+    # short probe timeout: a healthy backend inits in a few seconds; a
+    # hanging one should cost ~1 min total (2 x 30s + backoff), not 2 x 240s
+    # of the bench budget before the CPU fallback produces its number
     tries = int(os.environ.get("BENCH_BACKEND_TRIES", 2))
-    timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT", 240))
+    timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT", 30))
     info = {"ok": False, "error": "no probe ran"}
     for i in range(tries):
         info = _probe_backend(timeout_s)
@@ -156,6 +159,30 @@ def run_bench(backend_info: dict) -> dict:
         # an error, never a healthy-looking throughput number
         higgs_equiv = 0.0
         vs_baseline = 0.0
+    # serving-side throughput: the model just trained, served through the
+    # compiled bucketed predictor cache (lightgbm_tpu.serving) — warmup
+    # compiles every bucket, the timed window must be recompile-free
+    serve = {}
+    if os.environ.get("BENCH_SERVE", "1") != "0" and train_auc_ok:
+        try:
+            from lightgbm_tpu.serving import ServingEngine
+            eng = ServingEngine(max_batch=int(
+                os.environ.get("BENCH_SERVE_BATCH", 4096)))
+            eng.registry.register_impl("bench", b)
+            eng.warmup(raw_scores=(True,))
+            rows = min(n, 65536)
+            t0 = time.time()
+            reps = 3
+            for _ in range(reps):
+                eng.predict("bench", X[:rows], raw_score=True)
+            dt_s = time.time() - t0
+            serve = {
+                "predict_rows_per_sec": round(rows * reps / dt_s, 1),
+                "serve_recompiles_after_warmup":
+                    eng.metrics.recompiles_after_warmup(),
+            }
+        except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
+            serve = {"predict_error": repr(e)[:200]}
     phases = {}
     if os.environ.get("BENCH_PHASES", "1") != "0":
         try:
@@ -209,6 +236,7 @@ def run_bench(backend_info: dict) -> dict:
                      "throughput zeroed" % auc}),
         "raw_iters_per_sec": round(iters_per_sec, 4),
         "rows_features_per_sec_per_chip": round(iters_per_sec * n * f, 1),
+        **serve,
         "phase_seconds": {"binning": round(t_bin, 3),
                           "compile_and_warmup": round(t_compile_warmup, 3),
                           "train_%d_iters" % iters: round(dt, 3),

@@ -694,6 +694,16 @@ class Booster:
         self._train_set = train_set
         return self
 
+    def as_serving_bundle(self, model_id: str = "default"):
+        """Package this booster for lightgbm_tpu.serving: trees stacked
+        ``[iterations, trees_per_iteration, ...]`` on device, immutable.
+        Register on a ServingEngine with
+        ``engine.registry.register(booster.as_serving_bundle(id))``."""
+        from .serving.registry import ModelBundle
+        check(self._impl is not None and self._impl.models,
+              "Cannot serve: no trained model")
+        return ModelBundle.from_booster(model_id, self)
+
     def refit(self, data, label, decay_rate: float = 0.9, weight=None,
               group=None, **kwargs) -> "Booster":
         """Refit existing tree structures to new data (RefitTree,

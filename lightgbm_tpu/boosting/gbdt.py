@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 import numpy as np
 
+from ..compat import shard_map
 from ..config import Config
 from ..log import Log, LightGBMError, check
 from ..io.dataset import BinnedDataset
@@ -837,7 +838,7 @@ class GBDT:
                     return grow_tree(xbg, gj, hj, mj, meta, fm, params,
                                      axis_name=FEATURE_AXIS, fp=ctx)[:2]
 
-                grow_fp = jax.shard_map(
+                grow_fp = shard_map(
                     _fp_core, mesh=mesh,
                     in_specs=(P(), P(FEATURE_AXIS), ml_specs,
                               P(FEATURE_AXIS), P(), P(), P(), P()),
@@ -886,7 +887,7 @@ class GBDT:
                     cegb_specs = CegbState(
                         coupled_penalty=P(), lazy_penalty=P(),
                         feature_used=P(), row_used=P(None, DATA_AXIS))
-                    grow_cegb = jax.shard_map(
+                    grow_cegb = shard_map(
                         _grow_core_cegb,
                         mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
                                              P(DATA_AXIS), P(DATA_AXIS),
@@ -903,7 +904,7 @@ class GBDT:
                                          axis_name=DATA_AXIS,
                                          forced=forced_splits)[:2]
                 if not has_cegb:
-                    grow_sharded = jax.shard_map(
+                    grow_sharded = shard_map(
                         _grow_core,
                         mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS),
                                              P(DATA_AXIS), P(DATA_AXIS),

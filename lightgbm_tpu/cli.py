@@ -11,6 +11,13 @@ the four reference tasks (include/LightGBM/application.h:74):
 - ``task=refit``         — refit an existing model's leaf values on new data
   (gbdt.cpp:263-286)
 
+plus one TPU-native extension:
+
+- ``task=serve``         — boot the compiled batch-inference server
+  (lightgbm_tpu.serving): load ``input_model``, warm every batch bucket,
+  then answer HTTP or stdin JSON requests with zero recompiles. Also
+  reachable as ``python -m lightgbm_tpu.serving``.
+
 Argument handling mirrors Application::LoadParameters (application.cpp:48-81):
 ``key=value`` tokens on the command line, an optional ``config=`` file of
 ``key=value`` lines with ``#`` comments, command line taking precedence.
@@ -192,11 +199,18 @@ def run_refit(config: Config, params: Dict) -> None:
     Log.info("Finished refit; model saved to %s", config.output_model)
 
 
+def run_serve(config: Config, params: Dict) -> None:
+    from .serving.server import run_server
+
+    run_server(config, params)
+
+
 _TASKS = {
     "train": run_train, "training": run_train,
     "predict": run_predict, "prediction": run_predict, "test": run_predict,
     "convert_model": run_convert_model,
     "refit": run_refit, "refit_tree": run_refit,
+    "serve": run_serve, "serving": run_serve,
 }
 
 

@@ -1,0 +1,31 @@
+"""jax API compatibility shims.
+
+The explicit-collective learners target the modern spellings
+(``jax.shard_map``, ``lax.pcast``); older jax releases (<= 0.4.x) ship
+them as ``jax.experimental.shard_map.shard_map(check_rep=...)`` and have
+no pcast at all (their shard_map has no varying-axes type system to
+satisfy, so pcast degrades to identity). Everything below dispatches once
+at import time.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+if hasattr(lax, "pcast"):
+    def pcast(x, axes, to: str = "varying"):
+        return lax.pcast(x, axes, to=to)
+else:
+    def pcast(x, axes, to: str = "varying"):
+        return x

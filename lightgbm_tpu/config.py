@@ -191,6 +191,17 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # elsewhere. Larger chunks measured strictly worse on chip (65536 ->
     # 0.59x, 262144 -> 0.22x the 16384 throughput).
     ("tpu_row_chunk", int, 0, []),
+    # ---- serving (lightgbm_tpu.serving; task=serve) ----
+    ("serve_host", str, "127.0.0.1", []),
+    ("serve_port", int, 8080, []),            # 0 = OS-assigned (tests)
+    ("serve_max_batch", int, 4096, []),       # padded-batch cap / chunk size
+    ("serve_min_bucket", int, 16, []),        # smallest padded batch
+    ("serve_deadline_ms", float, 2.0, []),    # micro-batch coalesce window
+    ("serve_num_devices", int, 1, []),        # 0 = all local devices
+    ("serve_stdin", bool, False, []),         # JSON-lines on stdin/stdout
+    ("serve_warmup", bool, True, []),         # compile all buckets at boot
+    ("serve_metrics_file", str, "", []),      # JSON-lines metrics sink
+    ("serve_metrics_freq", float, 10.0, []),  # seconds between snapshots
 ]
 
 _CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}

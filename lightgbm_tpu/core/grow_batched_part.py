@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import pcast
 from .histogram import build_histogram
 from .grow import (GrowParams, TreeArrays, _empty_best, empty_tree,
                    expand_hist, propagate_monotone_bounds)
@@ -62,6 +63,21 @@ from .split import (BestSplit, FeatureMeta, K_MIN_SCORE,
                     calculate_leaf_output, find_best_split)
 
 PART_TILE = 2048   # kernel row tile AND segment alignment quantum
+
+
+def _local_slot_mask(slot_vals: jnp.ndarray, n_slots: int) -> jnp.ndarray:
+    """[n_slots] bool: which slots appear in ``slot_vals`` (-1 = none).
+
+    The pallas part-tiles kernel only WRITES the output block of a slot
+    that owns at least one local row tile — a slot with no local tiles
+    leaves its block uninitialized (histogram_pallas.py documents this).
+    Under a data-parallel shard_map a globally-valid leaf can easily have
+    zero rows on one shard, so masking by global validity alone would
+    feed that shard's garbage block into the psum. Negative entries are
+    routed to index ``n_slots`` and dropped (never wrapped to the last
+    slot)."""
+    idx = jnp.where(slot_vals >= 0, slot_vals, n_slots)
+    return jnp.zeros((n_slots,), bool).at[idx].set(True, mode="drop")
 
 
 def _part_capacity(n: int, num_leaves: int, tile: int) -> int:
@@ -142,8 +158,8 @@ def grow_tree_batched_part(xb: jnp.ndarray, grad: jnp.ndarray,
     row_leaf = jnp.where(ar < n, 0, -1).astype(jnp.int32)
     orig = jnp.where(ar < n, ar, -1)
     if axis_name is not None:
-        row_leaf = lax.pcast(row_leaf, (axis_name,), to="varying")
-        orig = lax.pcast(orig, (axis_name,), to="varying")
+        row_leaf = pcast(row_leaf, (axis_name,), to="varying")
+        orig = pcast(orig, (axis_name,), to="varying")
     leaf_begin = jnp.zeros((l,), jnp.int32)
     leaf_count = jnp.zeros((l,), jnp.int32).at[0].set(jnp.int32(n))
 
@@ -222,6 +238,11 @@ def grow_tree_batched_part(xb: jnp.ndarray, grad: jnp.ndarray,
                 slot_at, first, num_bins=b, n_slots=kb, row_tile=tile,
                 interpret=impl.endswith("interpret"),
                 highest="highest" in impl)                  # [kb, C, B, 6]
+            # the kernel leaves blocks of slots with NO local tiles
+            # uninitialized; those slots can still be globally valid under
+            # shard_map, so they must be zeroed here, per shard, before
+            # the psum — validity alone is not enough
+            has_tile = _local_slot_mask(slot_at, kb)        # [kb]
             ch_hist = jnp.stack([hist6[..., :3], hist6[..., 3:]],
                                 axis=1).reshape(2 * kb, ncols, b, 3)
         else:
@@ -233,8 +254,12 @@ def grow_tree_batched_part(xb: jnp.ndarray, grad: jnp.ndarray,
                 s.xb_fm.T, child_slot, active, s.vals3[0], s.vals3[1],
                 s.vals3[2] * active.astype(jnp.float32), b, kb, impl,
                 params.row_chunk, False)                    # [2K, C, B, 3]
-        valid2 = jnp.repeat(valid, 2)
-        ch_hist = jnp.where(valid2[:, None, None, None], ch_hist, 0.0)
+            # scatter-built histograms are zero-initialized, so this mask
+            # is a semantic no-op here — applying it anyway keeps the CPU
+            # shard_map tests exercising the same masking the kernel needs
+            has_tile = _local_slot_mask(jnp.where(active, slot_r, -1), kb)
+        keep2 = jnp.repeat(valid & has_tile, 2)
+        ch_hist = jnp.where(keep2[:, None, None, None], ch_hist, 0.0)
         ch_hist = psum(ch_hist)
 
         # ---- apply the permutation (DataPartition::Split analog) --------

@@ -138,6 +138,28 @@ def predict_forest_leaves_raw(trees: PredictTree, x: jnp.ndarray) -> jnp.ndarray
     return leaves.T
 
 
+def predict_forest_scores(trees: PredictTree, x: jnp.ndarray) -> jnp.ndarray:
+    """[N, K] raw scores from trees stacked [iters, K, ...] — the serving
+    forward pass (lightgbm_tpu.serving): all K class trees of an iteration
+    are applied in one vmapped step, so a whole multiclass model is ONE
+    compiled program per batch shape instead of K per-class programs.
+
+    Per-class summation order is iteration order — identical to the
+    per-class path GBDT.predict takes, so f32 accumulation matches it
+    bit-for-bit.
+    """
+    n = x.shape[0]
+    k = trees.leaf_value.shape[1]
+
+    def body(acc, tree_k):
+        delta = jax.vmap(lambda t: predict_tree_raw(t, x))(tree_k)  # [K, N]
+        return acc + delta.T, None
+
+    init = jnp.zeros((n, k), jnp.float32)
+    out, _ = lax.scan(body, init, trees)
+    return out
+
+
 def predict_forest_early_stop(trees: PredictTree, x: jnp.ndarray,
                               freq: int, margin: float,
                               is_multiclass: bool) -> jnp.ndarray:

@@ -315,6 +315,15 @@ def parse_model_string(model_str: str) -> Dict:
     return result
 
 
+def parse_model_file(path: str) -> Dict:
+    """Load + parse a model-text file (GBDT::LoadModelFromFile analog).
+
+    The serving registry uses this as its fail-fast pass: a malformed file
+    raises here, before any Booster/device state is built."""
+    with open(path, "r") as fh:
+        return parse_model_string(fh.read())
+
+
 def model_to_json(booster, feature_names: List[str],
                   feature_infos: List[str],
                   num_iteration: Optional[int] = None) -> str:

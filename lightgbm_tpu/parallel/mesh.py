@@ -58,6 +58,22 @@ def build_mesh(config: Config, devices=None) -> Optional[Mesh]:
     return None
 
 
+def serving_mesh(num_devices: int = 0, devices=None) -> Optional[Mesh]:
+    """1-D data mesh for the serving path (lightgbm_tpu.serving): padded
+    request batches are row-sharded over the data axis, trees replicated —
+    the inference analog of the data-parallel training layout above.
+
+    ``num_devices`` 0 means all local devices; a single device (or a
+    single-device request) returns None and everything runs unsharded.
+    """
+    devices = devices if devices is not None else jax.devices()
+    nd = len(devices) if num_devices <= 0 else min(int(num_devices),
+                                                   len(devices))
+    if nd <= 1:
+        return None
+    return Mesh(np.asarray(devices[:nd]), (DATA_AXIS,))
+
+
 def row_sharding(mesh: Optional[Mesh], extra_dims: int = 0):
     """Sharding for [N, ...] arrays: rows over the data axis."""
     if mesh is None:

@@ -26,6 +26,21 @@ def _timed(fn, *args, reps=3, **kw) -> float:
     return (time.perf_counter() - t0) / reps
 
 
+def latency_summary(samples_ms) -> Dict[str, float]:
+    """Quantile summary of a latency sample window (milliseconds) — the
+    serving-side SLO view (p50/p90/p99) shared by serving.metrics and any
+    offline analysis of its JSON-lines output."""
+    a = np.asarray(list(samples_ms), np.float64)
+    if a.size == 0:
+        return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0, "p90_ms": 0.0,
+                "p99_ms": 0.0, "max_ms": 0.0}
+    p50, p90, p99 = np.percentile(a, [50.0, 90.0, 99.0])
+    return {"count": int(a.size), "mean_ms": round(float(a.mean()), 4),
+            "p50_ms": round(float(p50), 4), "p90_ms": round(float(p90), 4),
+            "p99_ms": round(float(p99), 4),
+            "max_ms": round(float(a.max()), 4)}
+
+
 def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     """Per-phase seconds for one boosting iteration's building blocks, using
     the booster's actual data/shapes. Keys: grad, hist_full,

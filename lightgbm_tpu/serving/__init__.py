@@ -1,0 +1,27 @@
+"""lightgbm_tpu.serving — compiled, shape-bucketed batch inference.
+
+The training side of this repo grows trees; this package serves them under
+heavy traffic without ever recompiling after warmup:
+
+- registry.py   model files -> immutable device-resident tree bundles
+- predictor.py  compiled-predictor cache, power-of-two batch bucketing
+- batching.py   deadline-bounded micro-batch coalescing queue
+- server.py     HTTP / stdin front-ends (cli.py task=serve)
+- metrics.py    latency quantiles, cache + XLA-recompile counters
+
+Entry points: ``python -m lightgbm_tpu.serving input_model=model.txt`` or
+``python -m lightgbm_tpu task=serve input_model=model.txt``; in-process,
+build a ServingEngine and register boosters directly (see docs/Serving.md).
+"""
+from .batching import MicroBatchQueue
+from .metrics import ServingMetrics, backend_compile_count, install_compile_hook
+from .predictor import ServingEngine, bucket_rows, bucket_sizes
+from .registry import ModelBundle, ModelRegistry
+from .server import ServingApp, build_app, make_server, run_server, serve_stdin
+
+__all__ = [
+    "MicroBatchQueue", "ModelBundle", "ModelRegistry", "ServingApp",
+    "ServingEngine", "ServingMetrics", "backend_compile_count",
+    "bucket_rows", "bucket_sizes", "build_app", "install_compile_hook",
+    "make_server", "run_server", "serve_stdin",
+]

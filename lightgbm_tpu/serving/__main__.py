@@ -1,0 +1,18 @@
+"""``python -m lightgbm_tpu.serving input_model=model.txt [key=value ...]``
+
+Same key=value argument convention as the main CLI; task is forced to
+serve. See docs/Serving.md for the serve_* parameters.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from ..cli import main as cli_main
+    argv = sys.argv[1:] if argv is None else argv
+    return cli_main(["task=serve"] + list(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Micro-batching queue: coalesce concurrent requests into one forward pass.
+
+Individual serving requests are tiny (often 1 row); dispatching each as its
+own device call wastes the accelerator and pays per-call latency. The queue
+holds arriving requests for at most ``deadline_ms`` and fuses every
+compatible request — same ``(model_id, raw_score, num_iteration)`` — into
+ONE padded bucketed pass through the ServingEngine, then scatters the rows
+of the result back to each caller's Future.
+
+Deadline semantics: the clock starts at the OLDEST queued request, so a
+request never waits more than ``deadline_ms`` in the queue regardless of
+traffic; a full bucket (``max_rows``) dispatches immediately. This is the
+classic serving trade — p50 rises by at most the deadline, throughput
+scales with the bucket — and ``deadline_ms=0`` degrades to pass-through
+(still fusing whatever is already queued).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..log import LightGBMError
+from .predictor import ServingEngine
+
+
+class _Request:
+    __slots__ = ("key", "X", "future", "t")
+
+    def __init__(self, key, X, future):
+        self.key = key
+        self.X = X
+        self.future = future
+        self.t = time.perf_counter()
+
+
+class MicroBatchQueue:
+    """Deadline-bounded request coalescer in front of a ServingEngine."""
+
+    def __init__(self, engine: ServingEngine, max_rows: Optional[int] = None,
+                 deadline_ms: float = 2.0):
+        self.engine = engine
+        self.max_rows = int(max_rows) if max_rows else engine.max_batch
+        self.deadline_s = max(float(deadline_ms), 0.0) / 1000.0
+        self._queue: List[_Request] = []
+        self._cond = threading.Condition()
+        self._running = False
+        self._worker: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "MicroBatchQueue":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._worker = threading.Thread(target=self._loop,
+                                        name="lgbm-serve-batcher", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+            self._worker = None
+        # fail any stragglers rather than hanging their callers
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+        for r in leftovers:
+            r.future.set_exception(LightGBMError("serving queue stopped"))
+
+    # ------------------------------------------------------------ submit
+    def submit(self, model_id: str, X, raw_score: bool = False,
+               num_iteration: Optional[int] = None) -> "Future":
+        """Enqueue one request; the Future resolves to the same array
+        ``engine.predict`` would return for it alone."""
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        fut: Future = Future()
+        req = _Request((model_id, bool(raw_score), num_iteration), X, fut)
+        with self._cond:
+            if not self._running:
+                raise LightGBMError("MicroBatchQueue.submit before start()")
+            self._queue.append(req)
+            self.engine.metrics.set_queue_depth(len(self._queue))
+            self._cond.notify_all()
+        return fut
+
+    def predict(self, model_id: str, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None) -> np.ndarray:
+        """Blocking convenience wrapper around submit()."""
+        return self.submit(model_id, X, raw_score, num_iteration).result()
+
+    # ------------------------------------------------------------ worker
+    def _collect(self) -> List[_Request]:
+        """Under the lock: wait out the head request's deadline, then take
+        every queued request sharing its key (arrival order preserved)."""
+        head = self._queue[0]
+        deadline = head.t + self.deadline_s
+        while self._running:
+            rows = 0
+            for r in self._queue:
+                if r.key == head.key:
+                    rows += r.X.shape[0]
+            now = time.perf_counter()
+            if rows >= self.max_rows or now >= deadline:
+                break
+            self._cond.wait(timeout=deadline - now)
+        taken = [r for r in self._queue if r.key == head.key]
+        self._queue = [r for r in self._queue if r.key != head.key]
+        self.engine.metrics.set_queue_depth(len(self._queue))
+        return taken
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self._queue:
+                    self._cond.wait()
+                if not self._running:
+                    return
+                batch = self._collect()
+            if batch:
+                self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        model_id, raw_score, num_iteration = batch[0].key
+        try:
+            X = (batch[0].X if len(batch) == 1
+                 else np.concatenate([r.X for r in batch], axis=0))
+            out = self.engine.predict(model_id, X, raw_score=raw_score,
+                                      num_iteration=num_iteration,
+                                      _record_request=False)
+            done = time.perf_counter()
+            lo = 0
+            for r in batch:
+                hi = lo + r.X.shape[0]
+                r.future.set_result(out[lo:hi])
+                # per-CALLER accounting: latency includes the coalescing
+                # wait (what the caller actually observed)
+                self.engine.metrics.record_request(r.X.shape[0], done - r.t)
+                lo = hi
+        except Exception as e:  # noqa: BLE001 - delivered to each caller
+            self.engine.metrics.record_error()
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)

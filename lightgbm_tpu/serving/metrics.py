@@ -1,0 +1,150 @@
+"""Serving metrics: latency quantiles, queue depth, cache and compile counts.
+
+Two sources of truth for "did we recompile":
+
+- the predictor cache's own miss counter (every miss creates + compiles a
+  new bucketed predictor), and
+- a process-wide XLA backend-compile hook riding jax.monitoring's
+  ``/jax/core/compile/backend_compile_duration`` event — this counts REAL
+  backend compilations, so it also catches accidental retraces inside an
+  already-cached predictor (shape leaks, weak-type flips) that the cache
+  key cannot see.
+
+Snapshots export as JSON (one object) or JSON-lines (append per snapshot),
+the schema documented in docs/Serving.md.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from ..profiling import latency_summary
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_count = 0
+_hook_lock = threading.Lock()
+_hook_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    global _compile_count
+    if event == _BACKEND_COMPILE_EVENT:
+        with _hook_lock:
+            _compile_count += 1
+
+
+def install_compile_hook() -> None:
+    """Register the backend-compile listener (idempotent, process-wide)."""
+    global _hook_installed
+    with _hook_lock:
+        if _hook_installed:
+            return
+        _hook_installed = True
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+
+
+def backend_compile_count() -> int:
+    """XLA backend compilations observed since the hook was installed."""
+    with _hook_lock:
+        return _compile_count
+
+
+class ServingMetrics:
+    """Aggregated serving counters + a bounded latency window."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0                 # padded forward passes dispatched
+        self.cache_hits = 0
+        self.cache_misses = 0            # == predictor compiles requested
+        self.errors = 0
+        self.queue_depth = 0             # gauge, updated by the batch queue
+        self._latency_ms = collections.deque(maxlen=window)
+        self._batch_rows = collections.deque(maxlen=window)
+        self._compile_floor = 0          # backend compiles at warmup end
+        self._miss_floor = 0             # cache misses at warmup end
+        install_compile_hook()
+
+    # ------------------------------------------------------------ recording
+    def record_request(self, rows: int, latency_s: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self._latency_ms.append(latency_s * 1000.0)
+
+    def record_batch(self, rows: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self._batch_rows.append(rows)
+
+    def record_cache(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+
+    def mark_warmup_done(self) -> None:
+        """Anchor the recompile counter: compiles past this point are
+        recompiles (the serve_smoke.py zero-recompile assertion)."""
+        with self._lock:
+            self._compile_floor = _compile_count
+            self._miss_floor = self.cache_misses
+
+    def recompiles_after_warmup(self) -> int:
+        with self._lock:
+            return _compile_count - self._compile_floor
+
+    def cache_misses_after_warmup(self) -> int:
+        with self._lock:
+            return self.cache_misses - self._miss_floor
+
+    # ------------------------------------------------------------ export
+    def snapshot(self) -> Dict:
+        with self._lock:
+            lat = latency_summary(self._latency_ms)
+            rows_per_batch = (float(sum(self._batch_rows))
+                              / max(len(self._batch_rows), 1))
+            return {
+                "ts": round(time.time(), 3),
+                "uptime_s": round(time.time() - self._t0, 3),
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "rows_per_batch": round(rows_per_batch, 2),
+                "queue_depth": self.queue_depth,
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "errors": self.errors,
+                "backend_compiles": _compile_count,
+                "recompiles_after_warmup":
+                    _compile_count - self._compile_floor,
+                "latency_ms": lat,
+            }
+
+    def write_jsonl(self, path_or_fh) -> Dict:
+        """Append one snapshot as a JSON line; returns the snapshot."""
+        snap = self.snapshot()
+        line = json.dumps(snap, sort_keys=True) + "\n"
+        if hasattr(path_or_fh, "write"):
+            path_or_fh.write(line)
+            path_or_fh.flush()
+        else:
+            with open(path_or_fh, "a") as fh:
+                fh.write(line)
+        return snap

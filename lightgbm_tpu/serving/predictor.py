@@ -1,0 +1,206 @@
+"""Compiled-predictor cache: shape-bucketed, zero-recompile batch inference.
+
+XLA compiles one executable per input shape, so serving arbitrary request
+sizes naively would retrace on every new batch size — the exact failure
+mode the ROADMAP's "heavy traffic" goal cannot afford. The cache here is
+keyed ``(model_id, bucket, raw_score, num_iteration)``:
+
+- request rows are padded up to a POWER-OF-TWO bucket (floored at
+  ``min_bucket``, capped at ``max_batch``; larger requests are chunked),
+  so at most ``log2(max_batch / min_bucket) + 1`` shapes exist per key
+  prefix and a warmup pass over them makes every later request a cache
+  hit with zero new compilations;
+- each cache entry owns ONE jit-compiled function closed over nothing —
+  trees ride in as device-resident arguments — so entries never interfere
+  and a cache miss maps 1:1 to a compilation request;
+- the raw->output transform (sigmoid / softmax / exp) and the
+  average-output division are baked INTO the compiled function, keeping a
+  whole request one device round-trip.
+
+Multi-device: with a serving mesh (parallel/mesh.py serving_mesh) the
+padded batch is row-sharded and trees replicated; GSPMD partitions the
+forest apply. Buckets smaller than the mesh run replicated — the dispatch
+decision is a static property of the cache key, so warmup covers it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tree as tree_mod
+from ..log import LightGBMError, check
+from ..parallel.mesh import replicated, row_sharding, serving_mesh
+from .metrics import ServingMetrics
+from .registry import ModelBundle, ModelRegistry
+
+
+def bucket_rows(n: int, min_bucket: int = 16, max_batch: int = 4096) -> int:
+    """Power-of-two padded size for an ``n``-row request (chunks of
+    ``max_batch`` beyond the cap)."""
+    check(n >= 1, "empty prediction request")
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b <<= 1
+    return min(b, int(max_batch))
+
+
+def bucket_sizes(min_bucket: int = 16, max_batch: int = 4096) -> List[int]:
+    """Every bucket the cache can produce — the warmup schedule."""
+    out = []
+    b = max(int(min_bucket), 1)
+    while b < int(max_batch):
+        out.append(b)
+        b <<= 1
+    out.append(int(max_batch))
+    return out
+
+
+class _CompiledPredictor:
+    """One cache entry: a jit function pinned to (trees, bucket, transform)."""
+
+    def __init__(self, bundle: ModelBundle, bucket: int, raw_score: bool,
+                 num_iteration: int, mesh=None):
+        self.bucket = bucket
+        trees = bundle.trees_for(num_iteration)
+        self._mesh = mesh
+        # static per-entry dispatch: shard rows when the bucket tiles the
+        # mesh evenly, otherwise replicate the batch too (tiny buckets)
+        self._shard = (mesh is not None
+                       and bucket % mesh.devices.size == 0)
+        if mesh is not None:
+            trees = jax.device_put(trees, replicated(mesh))
+            self._x_sharding = (row_sharding(mesh, extra_dims=1)
+                                if self._shard else replicated(mesh))
+        else:
+            self._x_sharding = None
+        self._trees = trees
+        convert = (None if raw_score or bundle.objective is None
+                   else bundle.objective.convert_output)
+        avg_iters = num_iteration if bundle.average_output else 0
+
+        def apply(t, x):
+            out = tree_mod.predict_forest_scores(t, x)      # [bucket, K] f32
+            if avg_iters:
+                out = out / np.float32(avg_iters)
+            if convert is not None:
+                out = convert(out)
+            return out
+
+        self._fn = jax.jit(apply)
+
+    def __call__(self, xpad: np.ndarray) -> jnp.ndarray:
+        x = (jax.device_put(xpad, self._x_sharding)
+             if self._x_sharding is not None else jnp.asarray(xpad))
+        return self._fn(self._trees, x)
+
+
+class ServingEngine:
+    """Registry + predictor cache + (optional) mesh: the serve path's core.
+
+    ``predict`` is thread-safe and synchronous; the micro-batching queue
+    (serving/batching.py) sits in front of it for concurrent traffic.
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 max_batch: int = 4096, min_bucket: int = 16,
+                 num_devices: int = 1,
+                 metrics: Optional[ServingMetrics] = None):
+        check(max_batch >= 1 and min_bucket >= 1,
+              "serve_max_batch and serve_min_bucket must be >= 1")
+        # normalize both to powers of two so bucket_rows' ladder is exact
+        self.min_bucket = 1 << (int(min_bucket) - 1).bit_length()
+        self.max_batch = max(1 << (int(max_batch) - 1).bit_length(),
+                             self.min_bucket)
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.mesh = serving_mesh(num_devices) if num_devices != 1 else None
+        self._cache: Dict[Tuple, _CompiledPredictor] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ cache
+    def _predictor(self, bundle: ModelBundle, bucket: int, raw_score: bool,
+                   iters: int) -> _CompiledPredictor:
+        key = (bundle.model_id, bucket, bool(raw_score), iters)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is None:
+                entry = _CompiledPredictor(bundle, bucket, raw_score, iters,
+                                           mesh=self.mesh)
+                self._cache[key] = entry
+                hit = False
+            else:
+                hit = True
+        self.metrics.record_cache(hit)
+        return entry
+
+    def cache_size(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    # ------------------------------------------------------------ predict
+    def predict(self, model_id: str, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                _record_request: bool = True) -> np.ndarray:
+        """Serve one request; output matches ``Booster.predict`` (same f32
+        accumulation order, same transform) for any request size.
+        ``_record_request=False`` is for the micro-batch queue, which
+        accounts its callers itself (per-caller count + queue-inclusive
+        latency) so a fused dispatch is not double-counted."""
+        t0 = time.perf_counter()
+        bundle = self.registry.get(model_id)
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        check(X.ndim == 2, "prediction input must be 2-D")
+        if bundle.num_features:
+            check(X.shape[1] == bundle.num_features,
+                  "model %r expects %d features, request has %d"
+                  % (model_id, bundle.num_features, X.shape[1]))
+        iters = bundle.effective_iterations(num_iteration)
+        n = X.shape[0]
+        outs = []
+        for lo in range(0, n, self.max_batch):
+            xc = X[lo:lo + self.max_batch]
+            b = bucket_rows(xc.shape[0], self.min_bucket, self.max_batch)
+            xpad = xc
+            if b != xc.shape[0]:
+                xpad = np.zeros((b, X.shape[1]), np.float32)
+                xpad[:xc.shape[0]] = xc
+            entry = self._predictor(bundle, b, raw_score, iters)
+            out = np.asarray(entry(xpad), np.float64)[:xc.shape[0]]
+            self.metrics.record_batch(b)
+            outs.append(out)
+        out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+        if bundle.num_tree_per_iteration == 1:
+            out = out[:, 0]
+        if _record_request:
+            self.metrics.record_request(n, time.perf_counter() - t0)
+        return out
+
+    # ------------------------------------------------------------ warmup
+    def warmup(self, model_ids: Optional[Iterable[str]] = None,
+               raw_scores: Iterable[bool] = (False,),
+               num_iterations: Iterable[Optional[int]] = (None,)) -> int:
+        """Compile every bucket for the given key prefixes so live traffic
+        never compiles; returns the number of entries warmed. Marks the
+        metrics recompile floor when done."""
+        ids = list(model_ids) if model_ids is not None else self.registry.ids()
+        warmed = 0
+        for mid in ids:
+            bundle = self.registry.get(mid)
+            nf = max(bundle.num_features, 1)
+            for b in bucket_sizes(self.min_bucket, self.max_batch):
+                zeros = np.zeros((b, nf), np.float32)
+                for raw in raw_scores:
+                    for ni in num_iterations:
+                        iters = bundle.effective_iterations(ni)
+                        entry = self._predictor(bundle, b, raw, iters)
+                        jax.block_until_ready(entry(zeros))
+                        warmed += 1
+        self.metrics.mark_warmup_done()
+        return warmed

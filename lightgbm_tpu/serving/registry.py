@@ -1,0 +1,139 @@
+"""Model registry: model files -> immutable device-resident tree bundles.
+
+The serving analog of the reference's prediction application layer
+(src/application/predictor.hpp): a model is loaded ONCE, its trees are
+packed to model-wide fixed shapes (core/tree.py pack_predict_table) and
+stacked ``[iterations, num_tree_per_iteration, ...]`` on device, and every
+request thereafter only reads the bundle. Bundles are immutable — capping
+``num_iteration`` slices the stacked arrays (cheap device slice, cached),
+never mutates them — so concurrent request threads need no locking past
+the registry dict itself.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..log import LightGBMError, check
+
+
+class ModelBundle:
+    """One loaded model, ready to serve.
+
+    ``trees`` holds the PredictTree arrays stacked ``[I, K, ...]`` where
+    ``I`` is boosting iterations and ``K`` trees-per-iteration (1 unless
+    multiclass); ``objective`` supplies ``convert_output`` for non-raw
+    scores (None for custom-objective models, which serve raw only).
+    """
+
+    def __init__(self, model_id: str, trees, num_class: int, k: int,
+                 num_features: int, objective=None,
+                 average_output: bool = False,
+                 feature_names: Optional[List[str]] = None,
+                 pandas_categorical=None):
+        self.model_id = model_id
+        self.trees = trees
+        self.num_class = num_class
+        self.num_tree_per_iteration = k
+        self.num_features = num_features
+        self.objective = objective
+        self.average_output = average_output
+        self.feature_names = list(feature_names or [])
+        self.pandas_categorical = pandas_categorical
+        self.total_iterations = int(trees.leaf_value.shape[0])
+        self._capped: Dict[int, "jnp.ndarray"] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_impl(cls, model_id: str, impl,
+                  feature_names: Optional[List[str]] = None,
+                  pandas_categorical=None) -> "ModelBundle":
+        """Bundle a boosting driver (basic.Booster._impl or a GBDT built
+        directly, as bench.py does)."""
+        models = impl.models
+        check(len(models) > 0, "cannot serve an empty model")
+        k = max(impl.num_tree_per_iteration, 1)
+        total = (len(models) // k) * k   # drop a partial trailing iteration
+        stacked = impl._stacked_predict_trees(0, total)
+        trees = jax.tree.map(
+            lambda a: a.reshape((total // k, k) + a.shape[1:]), stacked)
+        if feature_names is None and getattr(impl, "train_data", None) is not None:
+            feature_names = list(impl.train_data.feature_names)
+        nf = len(feature_names) if feature_names else int(max(
+            (int(np.max(t.split_feature, initial=0)) for t in models),
+            default=0)) + 1
+        return cls(model_id, trees, num_class=impl.num_class, k=k,
+                   num_features=nf, objective=impl.objective,
+                   average_output=impl.average_output,
+                   feature_names=feature_names,
+                   pandas_categorical=pandas_categorical)
+
+    @classmethod
+    def from_booster(cls, model_id: str, booster) -> "ModelBundle":
+        return cls.from_impl(model_id, booster._impl,
+                             feature_names=booster._feature_names(),
+                             pandas_categorical=booster.pandas_categorical)
+
+    def effective_iterations(self, num_iteration: Optional[int]) -> int:
+        if num_iteration is None or num_iteration <= 0:
+            return self.total_iterations
+        return min(int(num_iteration), self.total_iterations)
+
+    def trees_for(self, num_iteration: Optional[int]):
+        """Stacked trees capped to ``num_iteration`` (the
+        GBDT::Predict num_iteration contract); full model returns the
+        original arrays, capped views are sliced once and cached."""
+        iters = self.effective_iterations(num_iteration)
+        if iters == self.total_iterations:
+            return self.trees
+        with self._lock:
+            if iters not in self._capped:
+                self._capped[iters] = jax.tree.map(lambda a: a[:iters],
+                                                   self.trees)
+            return self._capped[iters]
+
+
+class ModelRegistry:
+    """Named, immutable model bundles (the serving fleet's model store)."""
+
+    def __init__(self):
+        self._bundles: Dict[str, ModelBundle] = {}
+        self._lock = threading.Lock()
+
+    def load_file(self, model_id: str, path: str) -> ModelBundle:
+        """Load a LightGBM model-text file (io/model_text.py format)."""
+        from ..basic import Booster
+        from ..io.model_text import parse_model_file
+        parse_model_file(path)   # fail fast with a format error, not mid-serve
+        booster = Booster(model_file=path)
+        return self.register_booster(model_id, booster)
+
+    def register_booster(self, model_id: str, booster) -> ModelBundle:
+        return self.register(ModelBundle.from_booster(model_id, booster))
+
+    def register_impl(self, model_id: str, impl) -> ModelBundle:
+        return self.register(ModelBundle.from_impl(model_id, impl))
+
+    def register(self, bundle: ModelBundle) -> ModelBundle:
+        with self._lock:
+            if bundle.model_id in self._bundles:
+                raise LightGBMError("model id %r already registered"
+                                    % bundle.model_id)
+            self._bundles[bundle.model_id] = bundle
+        return bundle
+
+    def get(self, model_id: str) -> ModelBundle:
+        with self._lock:
+            b = self._bundles.get(model_id)
+        if b is None:
+            raise LightGBMError("unknown model id %r (registered: %s)"
+                                % (model_id, sorted(self._bundles)))
+        return b
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._bundles)

@@ -1,0 +1,212 @@
+"""Serving front-ends: HTTP (stdlib ThreadingHTTPServer) and JSON-lines stdin.
+
+The wire layer is deliberately thin — parse JSON, hand rows to the
+MicroBatchQueue, serialize the Future's result — so every interesting
+property (bucketing, zero-recompile, sharding, metrics) lives in the
+engine underneath and is shared by both transports and by in-process
+callers (bench.py, tools/serve_smoke.py).
+
+HTTP API:
+  POST /predict   {"model": "...", "data": [[...], ...],
+                   "raw_score": false, "num_iteration": null}
+                  -> {"model": ..., "rows": N, "predictions": [...]}
+  GET  /metrics   one ServingMetrics snapshot (docs/Serving.md schema)
+  GET  /healthz   {"status": "ok", "models": [...]}
+  GET  /models    registered model ids + shapes
+
+stdin mode (``serve_stdin=true``) speaks the same request objects, one JSON
+object per line, replies one JSON line each — the subprocess-friendly
+transport used by the CLI tests.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..log import Log, LightGBMError
+from .batching import MicroBatchQueue
+from .metrics import ServingMetrics
+from .predictor import ServingEngine, bucket_sizes
+from .registry import ModelRegistry
+
+
+def _predictions_payload(model_id: str, out: np.ndarray) -> Dict:
+    return {"model": model_id, "rows": int(np.asarray(out).shape[0]),
+            "predictions": np.asarray(out).tolist()}
+
+
+class ServingApp:
+    """Engine + queue + registry bound together for a transport to drive."""
+
+    def __init__(self, engine: ServingEngine,
+                 queue: Optional[MicroBatchQueue] = None):
+        self.engine = engine
+        self.queue = queue if queue is not None else MicroBatchQueue(engine)
+        self.queue.start()
+
+    # ------------------------------------------------------------ requests
+    def handle_predict(self, req: Dict) -> Dict:
+        model_id = req.get("model", "")
+        if not model_id:
+            ids = self.engine.registry.ids()
+            if len(ids) != 1:
+                raise LightGBMError(
+                    "request must name a model (registered: %s)" % ids)
+            model_id = ids[0]
+        data = req.get("data")
+        if data is None:
+            raise LightGBMError('request is missing "data"')
+        X = np.asarray(data, np.float32)
+        out = self.queue.predict(
+            model_id, X, raw_score=bool(req.get("raw_score", False)),
+            num_iteration=req.get("num_iteration"))
+        return _predictions_payload(model_id, out)
+
+    def handle_models(self) -> Dict:
+        models = []
+        for mid in self.engine.registry.ids():
+            b = self.engine.registry.get(mid)
+            models.append({"model": mid, "num_features": b.num_features,
+                           "num_class": b.num_class,
+                           "iterations": b.total_iterations})
+        return {"models": models}
+
+    def close(self) -> None:
+        self.queue.stop()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    app: ServingApp = None  # type: ignore[assignment]  # bound by make_server
+
+    def log_message(self, fmt, *args):  # route through our logger, not stderr
+        Log.debug("serve: " + fmt, *args)
+
+    def _reply(self, code: int, payload: Dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok",
+                              "models": self.app.engine.registry.ids()})
+        elif self.path == "/metrics":
+            self._reply(200, self.app.engine.metrics.snapshot())
+        elif self.path == "/models":
+            self._reply(200, self.app.handle_models())
+        else:
+            self._reply(404, {"error": "unknown path %r" % self.path})
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        if self.path != "/predict":
+            self._reply(404, {"error": "unknown path %r" % self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            self._reply(200, self.app.handle_predict(req))
+        except (LightGBMError, ValueError, KeyError) as e:
+            self.app.engine.metrics.record_error()
+            self._reply(400, {"error": str(e)})
+
+
+def make_server(app: ServingApp, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bind (not yet serving) — port 0 lets the OS pick (tests read
+    ``server.server_address``)."""
+    handler = type("BoundHandler", (_Handler,), {"app": app})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_stdin(app: ServingApp, in_stream=None, out_stream=None) -> int:
+    """One JSON request per line in, one JSON reply per line out; blank
+    line or EOF ends the session. Returns requests served."""
+    import sys
+    in_stream = in_stream if in_stream is not None else sys.stdin
+    out_stream = out_stream if out_stream is not None else sys.stdout
+    served = 0
+    for line in in_stream:
+        line = line.strip()
+        if not line:
+            break
+        try:
+            reply = app.handle_predict(json.loads(line))
+        except (LightGBMError, ValueError, KeyError) as e:
+            app.engine.metrics.record_error()
+            reply = {"error": str(e)}
+        out_stream.write(json.dumps(reply) + "\n")
+        out_stream.flush()
+        served += 1
+    return served
+
+
+def _metrics_writer(metrics: ServingMetrics, path: str, freq_s: float,
+                    stop: threading.Event) -> threading.Thread:
+    def loop():
+        while not stop.wait(max(freq_s, 0.1)):
+            metrics.write_jsonl(path)
+    t = threading.Thread(target=loop, name="lgbm-serve-metrics", daemon=True)
+    t.start()
+    return t
+
+
+def build_app(config: Config) -> ServingApp:
+    """Engine + queue from serve_* config; loads ``input_model`` (if any)
+    under id "default" — tests/embedders register models themselves."""
+    engine = ServingEngine(
+        max_batch=config.serve_max_batch, min_bucket=config.serve_min_bucket,
+        num_devices=config.serve_num_devices)
+    if config.input_model:
+        engine.registry.load_file("default", config.input_model)
+    app = ServingApp(engine, MicroBatchQueue(
+        engine, deadline_ms=config.serve_deadline_ms))
+    return app
+
+
+def run_server(config: Config, params: Optional[Dict] = None) -> int:
+    """cli.py task=serve entry: boot, warm every bucket, serve until EOF
+    (stdin mode) or interrupt (HTTP mode)."""
+    if not config.input_model:
+        raise LightGBMError("No model file: pass input_model=<file>")
+    app = build_app(config)
+    engine = app.engine
+    if config.serve_warmup:
+        warmed = engine.warmup()
+        Log.info("serve: warmed %d compiled predictors (buckets %s)",
+                 warmed, ",".join(str(b) for b in
+                                  bucket_sizes(engine.min_bucket,
+                                               engine.max_batch)))
+    stop = threading.Event()
+    if config.serve_metrics_file:
+        _metrics_writer(engine.metrics, config.serve_metrics_file,
+                        config.serve_metrics_freq, stop)
+    try:
+        if config.serve_stdin:
+            served = serve_stdin(app)
+            Log.info("serve: stdin session done, %d requests", served)
+            return 0
+        server = make_server(app, config.serve_host, config.serve_port)
+        Log.info("serve: listening on http://%s:%d (pid %d)",
+                 server.server_address[0], server.server_address[1],
+                 os.getpid())
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            Log.info("serve: interrupted, shutting down")
+        finally:
+            server.server_close()
+        return 0
+    finally:
+        stop.set()
+        if config.serve_metrics_file:
+            engine.metrics.write_jsonl(config.serve_metrics_file)
+        app.close()

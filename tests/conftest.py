@@ -32,6 +32,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-device training runs excluded from the tier-1 "
+        "fast suite (run with -m slow)")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)

@@ -82,6 +82,47 @@ def test_part_data_parallel_matches_single_device():
     np.testing.assert_allclose(p1, p8, rtol=2e-4, atol=2e-4)
 
 
+def test_local_slot_mask_semantics():
+    """The pre-psum mask for kernel output blocks: only slots with local
+    tiles survive; -1 (no slot) must DROP, never wrap to the last slot."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.grow_batched_part import _local_slot_mask
+
+    m = _local_slot_mask(jnp.asarray([-1, 2, 2, 0, -1], jnp.int32), 4)
+    np.testing.assert_array_equal(np.asarray(m), [True, False, True, False])
+    # a shard whose every tile is inactive contributes NOTHING — in
+    # particular -1 must not light up slot kb-1 via negative wrapping
+    m = _local_slot_mask(jnp.full((6,), -1, jnp.int32), 4)
+    assert not np.asarray(m).any()
+    m = _local_slot_mask(jnp.asarray([3, 3, 3], jnp.int32), 4)
+    np.testing.assert_array_equal(np.asarray(m), [False, False, False, True])
+
+
+def test_part_data_parallel_skewed_inactive_slots():
+    """Data-parallel parity on a row-SORTED dataset: leaves align with
+    contiguous row ranges, so nearly every (leaf, shard) pair has zero
+    local rows — the regime where an unmasked kernel block would feed
+    garbage into the psum (the mask under test is applied on both kernel
+    and fallback paths)."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    X, y = make_binary(n=2048)
+    order = np.argsort(X[:, 0], kind="stable")
+    X, y = X[order], y[order]
+    base = dict(BASE, tree_batch_splits=8, tpu_batched_part="true",
+                bagging_fraction=1.0)
+    b1 = _train(X, y, dict(base))
+    b8 = _train(X, y, dict(base, tree_learner="data", num_machines=1,
+                           mesh_shape=[8]))
+    for t1, t8 in zip(b1.models, b8.models):
+        np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                      np.asarray(t8.split_feature))
+    p1 = b1.predict(X[:200], raw_score=True)
+    p8 = b8.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(p1, p8, rtol=2e-4, atol=2e-4)
+
+
 def test_part_bagging_and_goss_ride_along():
     """Masked-out rows still travel through the partition (their leaf
     assignment must stay correct for the score update)."""

@@ -24,6 +24,7 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.slow
 def test_two_process_data_parallel_matches_single(tmp_path):
     port = _free_port()
     out = str(tmp_path / "rank0.json")

@@ -45,6 +45,7 @@ def test_build_mesh_shapes():
     assert mesh_mod.build_mesh(cfg) is None
 
 
+@pytest.mark.slow
 def test_data_parallel_matches_serial():
     """Data-parallel (rows sharded over 8 devices) must reproduce serial
     results: histograms are f32 sums so allow tiny drift
@@ -62,6 +63,7 @@ def test_data_parallel_matches_serial():
     np.testing.assert_allclose(ps, pd, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_data_parallel_uneven_rows():
     """Row count not divisible by 8: padding must not change results."""
     X, y = make_binary(n=2005)  # 2005 % 8 != 0
@@ -74,6 +76,7 @@ def test_data_parallel_uneven_rows():
     assert int(t.leaf_count[:t.num_leaves_actual].sum()) == 2005
 
 
+@pytest.mark.slow
 def test_data_parallel_uses_sharded_partition():
     """tree_learner=data rides the explicit shard_map partition path (each
     device partitions its local rows; only child histograms psum) whenever
@@ -150,6 +153,7 @@ def test_voting_parallel_small_top_k():
     assert auc > 0.9
 
 
+@pytest.mark.slow
 def test_data_parallel_through_python_api():
     X, y = make_binary(n=1600)
     bst = lgb.train({"objective": "binary", "tree_learner": "data",
@@ -165,7 +169,7 @@ def test_grow_tree_explicit_psum_path():
     from functools import partial
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from lightgbm_tpu.compat import shard_map
     from lightgbm_tpu.core.grow import grow_tree, GrowParams
     from lightgbm_tpu.core.split import SplitParams, FeatureMeta
 
@@ -211,6 +215,7 @@ def test_grow_tree_explicit_psum_path():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_goss_under_mesh_uses_real_counts():
     """GOSS top-k must size its threshold from the REAL row count, not the
     mesh-padding-inflated one (goss.hpp:87-135): padded rows carry
@@ -287,7 +292,8 @@ def test_sync_best_split_broadcasts_winner():
             cat_bitset=jnp.full((8,), rank.astype(jnp.uint32) + 7,
                                 jnp.uint32))
 
-    out = jax.jit(jax.shard_map(
+    from lightgbm_tpu.compat import shard_map
+    out = jax.jit(shard_map(
         lambda _: jax.tree.map(
             lambda a: a[None],
             sync_best_split(make(jax.lax.axis_index("f")), "f")),

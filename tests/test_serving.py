@@ -1,0 +1,381 @@
+"""lightgbm_tpu.serving — bucketing, parity, zero-recompile, transports.
+
+Contracts pinned here:
+- bucket_rows: power-of-two ladder with a min floor and a max cap, so the
+  compiled-shape universe is finite and warmup can enumerate it;
+- served predictions match Booster.predict to 1e-6 for EVERY golden model
+  (binary / multiclass / lambdarank / regression), exact-bucket and padded
+  sizes, single- and multi-device mesh (and bit-exactly in practice: the
+  serving forward pass accumulates f32 per class in iteration order, the
+  same order GBDT.predict uses);
+- after warmup over all buckets, randomized-size traffic causes ZERO new
+  predictor-cache misses and ZERO XLA backend compiles (jax.monitoring
+  hook) — the acceptance criterion tools/serve_smoke.py asserts at scale;
+- the micro-batch queue returns each caller exactly its rows, including
+  across coalesced mixed-size submissions and for error requests;
+- HTTP and stdin front-ends speak the documented JSON schema.
+
+Golden pred-ref comparisons (served output vs the reference CLI's
+predictions) additionally run when /root/reference example data exists.
+"""
+import io
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.serving import (MicroBatchQueue, ModelRegistry,
+                                  ServingEngine, ServingMetrics, build_app,
+                                  bucket_rows, bucket_sizes, make_server,
+                                  serve_stdin)
+from lightgbm_tpu.log import LightGBMError
+
+from conftest import make_binary
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+EXAMPLES = "/root/reference/examples"
+
+GOLDEN_MODELS = ["model_ref.txt", "multiclass_model_ref.txt",
+                 "rank_model_ref.txt", "regression_model_ref.txt"]
+
+
+def needs_ref_data(task, fname):
+    return pytest.mark.skipif(
+        not os.path.exists(os.path.join(EXAMPLES, task, fname)),
+        reason="reference %s example data not available" % task)
+
+
+# --------------------------------------------------------------- bucketing
+def test_bucket_rows_ladder():
+    assert bucket_rows(1) == 16          # min floor
+    assert bucket_rows(16) == 16         # exact power of two
+    assert bucket_rows(17) == 32         # next power of two
+    assert bucket_rows(100) == 128
+    assert bucket_rows(4096) == 4096
+    assert bucket_rows(5000) == 4096     # capped (engine chunks the rest)
+    assert bucket_rows(3, min_bucket=1) == 4
+    with pytest.raises(LightGBMError):
+        bucket_rows(0)
+
+
+def test_bucket_sizes_enumerates_ladder():
+    assert bucket_sizes(16, 4096) == [16, 32, 64, 128, 256, 512, 1024,
+                                      2048, 4096]
+    assert bucket_sizes(64, 64) == [64]
+    # engine normalizes non-powers up, so the ladder stays exact
+    eng = ServingEngine(max_batch=1000, min_bucket=10)
+    assert eng.min_bucket == 16 and eng.max_batch == 1024
+
+
+# ----------------------------------------------------------- golden parity
+def _engine_with(model_file, model_id, **kw):
+    eng = ServingEngine(**kw)
+    eng.registry.load_file(model_id, os.path.join(GOLDEN, model_file))
+    return eng
+
+
+@pytest.mark.parametrize("model_file", GOLDEN_MODELS)
+def test_served_matches_booster_predict(model_file):
+    """Every golden model, exact-bucket and padded sizes, raw and
+    transformed, vs Booster.predict on the same rows."""
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, model_file))
+    nf = bst.num_feature()
+    eng = _engine_with(model_file, "m", max_batch=256, min_bucket=16)
+    rng = np.random.RandomState(3)
+    for n in (1, 15, 16, 17, 100, 256, 300):   # padded, exact, chunked
+        X = rng.rand(n, nf).astype(np.float32) * 2
+        got = eng.predict("m", X)
+        ref = bst.predict(X)
+        np.testing.assert_allclose(got, ref, atol=1e-6, rtol=0)
+        got_raw = eng.predict("m", X, raw_score=True)
+        ref_raw = bst.predict(X, raw_score=True)
+        np.testing.assert_allclose(got_raw, ref_raw, atol=1e-6, rtol=0)
+
+
+@pytest.mark.parametrize("model_file", ["model_ref.txt",
+                                        "multiclass_model_ref.txt"])
+def test_served_matches_booster_multidevice(model_file):
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, model_file))
+    nf = bst.num_feature()
+    eng = _engine_with(model_file, "m", max_batch=128, min_bucket=4,
+                       num_devices=0)
+    assert eng.mesh is not None and eng.mesh.devices.size == 8
+    rng = np.random.RandomState(4)
+    # 4 < ndev (replicated entry), 8 == ndev, 100 -> 128 (sharded entry)
+    for n in (1, 4, 8, 9, 100, 128, 200):
+        X = rng.rand(n, nf).astype(np.float32) * 2
+        np.testing.assert_allclose(eng.predict("m", X), bst.predict(X),
+                                   atol=1e-6, rtol=0)
+
+
+def test_served_num_iteration_capping():
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model_ref.txt"))
+    nf = bst.num_feature()
+    eng = _engine_with("model_ref.txt", "m", max_batch=64)
+    X = np.random.RandomState(5).rand(20, nf).astype(np.float32)
+    for ni in (1, 3, None):
+        np.testing.assert_allclose(
+            eng.predict("m", X, num_iteration=ni),
+            bst.predict(X, num_iteration=ni), atol=1e-6, rtol=0)
+
+
+@needs_ref_data("binary_classification", "binary.test")
+def test_served_matches_reference_pred_file():
+    from lightgbm_tpu.io.parser import parse_file
+    X, _, _ = parse_file(os.path.join(EXAMPLES, "binary_classification",
+                                      "binary.test"), has_header=False)
+    eng = _engine_with("model_ref.txt", "m")
+    golden = np.loadtxt(os.path.join(GOLDEN, "pred_ref.txt"))
+    np.testing.assert_allclose(eng.predict("m", X), golden, atol=1e-6)
+
+
+@needs_ref_data("lambdarank", "rank.test")
+def test_served_matches_reference_rank_pred_file():
+    from lightgbm_tpu.io.parser import parse_file
+    X, _, _ = parse_file(os.path.join(EXAMPLES, "lambdarank", "rank.test"),
+                         has_header=False)
+    eng = _engine_with("rank_model_ref.txt", "m")
+    golden = np.loadtxt(os.path.join(GOLDEN, "rank_pred_ref.txt"))
+    np.testing.assert_allclose(eng.predict("m", X), golden, atol=1e-6)
+
+
+@needs_ref_data("multiclass_classification", "multiclass.test")
+def test_served_matches_reference_multiclass_pred_file():
+    from lightgbm_tpu.io.parser import parse_file
+    X, _, _ = parse_file(os.path.join(EXAMPLES, "multiclass_classification",
+                                      "multiclass.test"), has_header=False)
+    eng = _engine_with("multiclass_model_ref.txt", "m")
+    golden = np.loadtxt(os.path.join(GOLDEN, "multiclass_pred_ref.txt"))
+    np.testing.assert_allclose(eng.predict("m", X), golden, atol=1e-6)
+
+
+# ---------------------------------------------------------- zero recompile
+def test_zero_recompiles_after_warmup():
+    """The tentpole property: warmup enumerates every (bucket, raw) entry,
+    then randomized-size traffic never compiles again — asserted on BOTH
+    signals (predictor-cache misses and the XLA backend-compile hook)."""
+    eng = _engine_with("model_ref.txt", "m", max_batch=512, min_bucket=16)
+    nf = eng.registry.get("m").num_features
+    rng = np.random.RandomState(6)
+    # reference outputs computed BEFORE warmup: Booster.predict compiles
+    # per shape and would otherwise pollute the process-wide compile count
+    sizes = [int(s) for s in rng.randint(1, 1300, size=40)]
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model_ref.txt"))
+    queries = [rng.rand(n, nf).astype(np.float32) for n in sizes]
+    refs = [bst.predict(X) for X in queries]
+
+    warmed = eng.warmup(raw_scores=(False, True))
+    assert warmed == len(bucket_sizes(16, 512)) * 2
+    for X, ref in zip(queries, refs):
+        np.testing.assert_allclose(eng.predict("m", X), ref, atol=1e-6)
+    assert eng.metrics.cache_misses_after_warmup() == 0
+    assert eng.metrics.recompiles_after_warmup() == 0
+    assert eng.cache_size() == warmed
+
+
+# ------------------------------------------------------- micro-batch queue
+def test_micro_batch_queue_roundtrip():
+    eng = _engine_with("model_ref.txt", "m", max_batch=128)
+    nf = eng.registry.get("m").num_features
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model_ref.txt"))
+    q = MicroBatchQueue(eng, deadline_ms=10).start()
+    try:
+        rng = np.random.RandomState(7)
+        queries = [rng.rand(k, nf).astype(np.float32)
+                   for k in (1, 2, 5, 1, 30, 3)]
+        # mixed keys in flight at once: raw and transformed must not fuse
+        futs = [q.submit("m", X) for X in queries]
+        futs_raw = [q.submit("m", X, raw_score=True) for X in queries[:2]]
+        for X, f in zip(queries, futs):
+            np.testing.assert_allclose(f.result(timeout=60), bst.predict(X),
+                                       atol=1e-6)
+        for X, f in zip(queries, futs_raw):
+            np.testing.assert_allclose(f.result(timeout=60),
+                                       bst.predict(X, raw_score=True),
+                                       atol=1e-6)
+        assert eng.metrics.queue_depth == 0
+    finally:
+        q.stop()
+
+
+def test_micro_batch_queue_coalesces():
+    """With a generous deadline, requests submitted together dispatch as
+    fewer engine batches than requests."""
+    eng = _engine_with("model_ref.txt", "m", max_batch=64)
+    nf = eng.registry.get("m").num_features
+    eng.warmup()
+    base_batches = eng.metrics.batches
+    q = MicroBatchQueue(eng, deadline_ms=250).start()
+    try:
+        X = np.random.RandomState(8).rand(2, nf).astype(np.float32)
+        futs = [q.submit("m", X) for _ in range(8)]
+        for f in futs:
+            assert f.result(timeout=60).shape == (2,)
+    finally:
+        q.stop()
+    assert eng.metrics.batches - base_batches < 8   # fused
+    assert eng.metrics.requests == 8                # per-caller accounting
+
+
+def test_micro_batch_queue_error_delivery():
+    eng = _engine_with("model_ref.txt", "m")
+    q = MicroBatchQueue(eng, deadline_ms=1).start()
+    try:
+        bad = q.submit("m", np.zeros((2, 3), np.float32))   # wrong features
+        unknown = q.submit("nope", np.zeros((2, 3), np.float32))
+        with pytest.raises(LightGBMError):
+            bad.result(timeout=60)
+        with pytest.raises(LightGBMError):
+            unknown.result(timeout=60)
+    finally:
+        q.stop()
+    assert eng.metrics.errors >= 2
+
+
+# ----------------------------------------------------------------- metrics
+def test_metrics_snapshot_schema_and_jsonl(tmp_path):
+    m = ServingMetrics(window=8)
+    m.record_request(5, 0.002)
+    m.record_request(7, 0.004)
+    m.record_batch(16)
+    m.record_cache(hit=False)
+    m.record_cache(hit=True)
+    m.set_queue_depth(3)
+    snap = m.snapshot()
+    for key in ("ts", "uptime_s", "requests", "rows", "batches",
+                "rows_per_batch", "queue_depth", "cache_hits",
+                "cache_misses", "errors", "backend_compiles",
+                "recompiles_after_warmup", "latency_ms"):
+        assert key in snap, key
+    assert snap["requests"] == 2 and snap["rows"] == 12
+    assert snap["cache_hits"] == 1 and snap["cache_misses"] == 1
+    assert snap["queue_depth"] == 3
+    lat = snap["latency_ms"]
+    assert lat["count"] == 2 and lat["p50_ms"] <= lat["p99_ms"] <= lat["max_ms"]
+    path = tmp_path / "metrics.jsonl"
+    m.write_jsonl(str(path))
+    m.write_jsonl(str(path))
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2 and json.loads(lines[1])["requests"] == 2
+
+
+def test_latency_summary_quantiles():
+    from lightgbm_tpu.profiling import latency_summary
+    s = latency_summary(range(1, 101))
+    assert s["count"] == 100 and s["p50_ms"] == pytest.approx(50.5)
+    assert s["p99_ms"] == pytest.approx(99.01) and s["max_ms"] == 100
+    assert latency_summary([])["count"] == 0
+
+
+# -------------------------------------------------------------- front-ends
+def _golden_config(**extra):
+    d = {"task": "serve", "input_model": os.path.join(GOLDEN, "model_ref.txt"),
+         "serve_max_batch": 64, "serve_min_bucket": 8, "verbosity": -1}
+    d.update(extra)
+    return Config(d)
+
+
+def test_http_server_roundtrip():
+    app = build_app(_golden_config())
+    try:
+        bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model_ref.txt"))
+        nf = bst.num_feature()
+        srv = make_server(app, "127.0.0.1", 0)       # port 0: OS-assigned
+        host, port = srv.server_address
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            X = np.random.RandomState(9).rand(5, nf)
+            body = json.dumps({"data": X.tolist()}).encode()
+            rep = json.loads(urllib.request.urlopen(urllib.request.Request(
+                "http://%s:%d/predict" % (host, port), data=body)).read())
+            assert rep["rows"] == 5 and rep["model"] == "default"
+            np.testing.assert_allclose(rep["predictions"], bst.predict(X),
+                                       atol=1e-6)
+            met = json.loads(urllib.request.urlopen(
+                "http://%s:%d/metrics" % (host, port)).read())
+            assert met["requests"] == 1
+            health = json.loads(urllib.request.urlopen(
+                "http://%s:%d/healthz" % (host, port)).read())
+            assert health == {"status": "ok", "models": ["default"]}
+            models = json.loads(urllib.request.urlopen(
+                "http://%s:%d/models" % (host, port)).read())
+            assert models["models"][0]["num_features"] == nf
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    "http://%s:%d/predict" % (host, port), data=b"{}"))
+            assert exc.value.code == 400
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        app.close()
+
+
+def test_stdin_transport():
+    app = build_app(_golden_config())
+    try:
+        nf = app.engine.registry.get("default").num_features
+        X = np.random.RandomState(10).rand(3, nf)
+        lines = (json.dumps({"data": X.tolist()}) + "\n"
+                 + json.dumps({"data": [[0.0]]}) + "\n")   # second: bad width
+        out = io.StringIO()
+        served = serve_stdin(app, io.StringIO(lines), out)
+        assert served == 2
+        ok, bad = [json.loads(s) for s in out.getvalue().splitlines()]
+        assert ok["rows"] == 3
+        assert "error" in bad and "features" in bad["error"]
+    finally:
+        app.close()
+
+
+def test_cli_serve_stdin_subprocess():
+    """task=serve end to end through the real CLI in a subprocess."""
+    import subprocess
+    import sys
+    bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model_ref.txt"))
+    X = np.random.RandomState(11).rand(2, bst.num_feature())
+    req = json.dumps({"data": X.tolist()}) + "\n"
+    p = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+         "input_model=%s" % os.path.join(GOLDEN, "model_ref.txt"),
+         "serve_stdin=true", "serve_max_batch=16", "serve_min_bucket=8",
+         "serve_warmup=false", "verbosity=-1"],
+        input=req, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stderr[-1500:]
+    reply = json.loads([l for l in p.stdout.splitlines()
+                        if l.startswith("{")][-1])
+    np.testing.assert_allclose(reply["predictions"], bst.predict(X),
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_rejects_duplicates_and_unknown():
+    reg = ModelRegistry()
+    reg.load_file("m", os.path.join(GOLDEN, "model_ref.txt"))
+    with pytest.raises(LightGBMError):
+        reg.load_file("m", os.path.join(GOLDEN, "model_ref.txt"))
+    with pytest.raises(LightGBMError):
+        reg.get("other")
+    assert reg.ids() == ["m"]
+
+
+def test_trained_booster_served_in_process():
+    """The embedder path: train, as_serving_bundle, serve — no file."""
+    X, y = make_binary(n=400, f=6)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    eng = ServingEngine(max_batch=64, min_bucket=8)
+    eng.registry.register(bst.as_serving_bundle("live"))
+    np.testing.assert_allclose(eng.predict("live", X[:33]), bst.predict(X[:33]),
+                               atol=1e-6)
