@@ -52,8 +52,74 @@ def _probe_backend(timeout_s: float) -> dict:
         return {"ok": False, "error": repr(e)[:500]}
 
 
+def _probe_cache_key() -> str:
+    """The probe verdict is only valid for this jax build + device env."""
+    try:
+        import importlib.metadata as im
+        jax_ver = im.version("jax")
+    except Exception:  # noqa: BLE001 - cache key must never raise
+        jax_ver = "unknown"
+    env_bits = ";".join("%s=%s" % (k, os.environ.get(k, ""))
+                        for k in ("JAX_PLATFORMS", "TPU_NAME",
+                                  "PJRT_DEVICE", "TPU_SKIP_MDS_QUERY"))
+    return "jax=%s;%s" % (jax_ver, env_bits)
+
+
+def _probe_cache_path() -> str:
+    return os.environ.get(
+        "BENCH_PROBE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "lightgbm_tpu",
+                     "backend_probe.json"))
+
+
+def _probe_cache_load() -> dict:
+    try:
+        with open(_probe_cache_path()) as fh:
+            cached = json.load(fh)
+        if cached.get("key") == _probe_cache_key():
+            return cached.get("verdict", {})
+    except Exception:  # noqa: BLE001 - a bad cache means no cache
+        pass
+    return {}
+
+
+def _probe_cache_store(verdict: dict) -> None:
+    try:
+        path = _probe_cache_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump({"key": _probe_cache_key(), "verdict": verdict}, fh)
+    except Exception:  # noqa: BLE001 - caching is best-effort
+        pass
+
+
+def _cpu_fallback() -> None:
+    # force CPU via jax.config BEFORE any backend init in this process
+    # (env alone is not enough — a site hook may reset jax_platforms to
+    # the TPU plugin)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
 def _select_backend() -> dict:
-    """Probe the ambient (TPU) backend with retries; fall back to CPU."""
+    """Probe the ambient (TPU) backend with retries; fall back to CPU.
+
+    The verdict is cached (keyed on jax version + device env) so repeat
+    runs skip the probe subprocesses entirely — a hanging backend costs
+    the ~1 min probe budget ONCE per toolchain, not once per bench run.
+    A cached failure verdict deliberately carries NO probe_error string:
+    re-reporting the error text of a probe that ran under a prior run's
+    settings (e.g. an old BENCH_BACKEND_TIMEOUT) would be stale.
+    BENCH_PROBE_REFRESH=1 bypasses and overwrites the cache.
+    """
+    if os.environ.get("BENCH_PROBE_REFRESH", "0") not in ("1", "true"):
+        cached = _probe_cache_load()
+        if cached.get("ok"):
+            return {**cached, "probe_cached": True}
+        if cached.get("failed"):
+            _cpu_fallback()
+            return {"ok": True, "backend": "cpu", "n_devices": 1,
+                    "fallback": True, "probe_cached": True}
     # short probe timeout: a healthy backend inits in a few seconds; a
     # hanging one should cost ~1 min total (2 x 30s + backoff), not 2 x 240s
     # of the bench budget before the CPU fallback produces its number
@@ -63,16 +129,23 @@ def _select_backend() -> dict:
     for i in range(tries):
         info = _probe_backend(timeout_s)
         if info["ok"]:
+            _probe_cache_store(info)
             return info
         if i < tries - 1:
             time.sleep(5 * (i + 1))
-    # fall back to CPU: force it via jax.config BEFORE any backend init in
-    # this process (env alone is not enough — a site hook may reset
-    # jax_platforms to the TPU plugin)
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    _probe_cache_store({"failed": True})
+    _cpu_fallback()
     return {"ok": True, "backend": "cpu", "n_devices": 1,
             "fallback": True, "probe_error": info.get("error", "")}
+
+
+def _cpu_shaped(backend_info: dict) -> bool:
+    """True when the run executes on CPU — via fallback OR because the
+    ambient env (JAX_PLATFORMS=cpu) made the probe succeed on a cpu
+    backend. Both get the same row/iter caps and growth default so that
+    bench numbers stay comparable across the two ways of landing on CPU."""
+    return bool(backend_info.get("fallback")
+                or backend_info.get("backend") == "cpu")
 
 
 def run_bench(backend_info: dict) -> dict:
@@ -80,8 +153,9 @@ def run_bench(backend_info: dict) -> dict:
     f = HIGGS_FEATURES
     num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
     iters = int(os.environ.get("BENCH_ITERS", 10))
-    if backend_info.get("fallback"):
-        # CPU fallback: keep the shape honest but the wall-clock sane
+    cpu_shaped = _cpu_shaped(backend_info)
+    if cpu_shaped:
+        # CPU run: keep the shape honest but the wall-clock sane
         n = min(n, int(os.environ.get("BENCH_ROWS_CPU", 200_000)))
         iters = min(iters, 5)
 
@@ -100,9 +174,14 @@ def run_bench(backend_info: dict) -> dict:
     # round-4 on-chip decision (docs/Performance.md): EXACT growth over
     # the row partition is the measured winner on TPU (1.97 vs 1.73
     # iters/s for the best batched config at the bench shape) — the
-    # CPU-measured batched 2.0x inverted on chip. BENCH_TREE_GROWTH
+    # CPU-measured batched 2.0x inverted on chip, so exact stays the
+    # on-chip default until frontier growth is measured there. On a
+    # CPU-shaped run, frontier growth (O(depth) dataset sweeps per tree,
+    # core/grow_frontier.py) is the default: per-leaf sweeps dominate the
+    # exact path there (BENCH_r05 phase breakdown). BENCH_TREE_GROWTH
     # overrides; BENCH_BATCH_SPLITS sweeps K for batched runs.
-    growth = os.environ.get("BENCH_TREE_GROWTH", "exact")
+    growth_default = "frontier" if cpu_shaped else "exact"
+    growth = os.environ.get("BENCH_TREE_GROWTH", growth_default)
     cfg_d = {"objective": "binary", "num_leaves": num_leaves,
              "max_bin": 255, "verbosity": -1, "tree_growth": growth,
              "tree_batch_splits": int(os.environ.get("BENCH_BATCH_SPLITS",
@@ -211,8 +290,8 @@ def run_bench(backend_info: dict) -> dict:
     depth_avg = max(1.0, np.ceil(np.log2(max(num_leaves, 2))))
     # only meaningful for an honest TPU run: zeroed with the throughput
     # fields when the AUC guard fires, and not emitted against the v5e
-    # roofline for a CPU-fallback run
-    if train_auc_ok and not backend_info.get("fallback"):
+    # roofline for a CPU-shaped run
+    if train_auc_ok and not cpu_shaped:
         mfu = (iters_per_sec * n * f * depth_avg * flops_per_visit
                / peak_flops)
     else:
@@ -228,7 +307,12 @@ def run_bench(backend_info: dict) -> dict:
         "tree_growth": growth,
         "backend": backend_info.get("backend", "?"),
         "backend_fallback": bool(backend_info.get("fallback", False)),
-        "probe_error": backend_info.get("probe_error", ""),
+        "probe_cached": bool(backend_info.get("probe_cached", False)),
+        # only a probe that ran THIS run may report an error string — a
+        # cached failure verdict re-reporting a prior run's message (with
+        # that run's timeout values baked into the text) would be stale
+        **({"probe_error": backend_info["probe_error"]}
+           if backend_info.get("probe_error") else {}),
         "train_auc": round(float(auc), 4),
         "train_auc_ok": train_auc_ok,
         **({} if train_auc_ok else
@@ -278,7 +362,7 @@ def main():
             # fail transiently; one retry on the plain-XLA histogram path
             # still produces a real number
             if os.environ.get("BENCH_HIST_IMPL") or \
-                    backend_info.get("fallback"):
+                    _cpu_shaped(backend_info):
                 raise
             os.environ["BENCH_HIST_IMPL"] = "matmul"
             try:

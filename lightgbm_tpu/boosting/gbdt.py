@@ -350,24 +350,28 @@ class GBDT:
             raise LightGBMError("forced splits / CEGB are not supported "
                                 "with the voting-parallel tree learner")
 
-        # batched-frontier growth (core/grow_batched.py): incompatible with
-        # anything whose bookkeeping depends on exact one-split-at-a-time
-        # ordering
+        # batched-frontier growth (core/grow_batched.py) and frontier-wave
+        # growth (core/grow_frontier.py): both incompatible with anything
+        # whose bookkeeping depends on exact one-split-at-a-time ordering
         batch_splits = 0
-        if cfg.tree_growth == "batched":
+        frontier_mode = False
+        if cfg.tree_growth in ("batched", "frontier"):
+            mode = "tree_growth=%s" % cfg.tree_growth
             if num_forced > 0 or self._cegb_state is not None:
                 raise LightGBMError(
-                    "tree_growth=batched requires exact split ordering; "
-                    "disable forced splits / CEGB or use tree_growth=exact")
+                    mode + " requires exact split ordering; disable forced "
+                    "splits / CEGB or use tree_growth=exact")
             if cfg.tree_learner in ("voting", "feature"):
                 raise LightGBMError(
-                    "tree_growth=batched supports the serial and data tree "
-                    "learners only (got tree_learner=%s)" % cfg.tree_learner)
+                    mode + " supports the serial and data tree learners "
+                    "only (got tree_learner=%s)" % cfg.tree_learner)
             if _hist_dtype(cfg) == "f64":
-                # grow_tree_batched accumulates f32 (slot kernel layout);
+                # both wave growers accumulate f32 (slot kernel layout);
                 # silently downgrading would betray the dp promise
-                Log.warning("tree_growth=batched does not support f64 "
-                            "histograms yet; falling back to exact growth")
+                Log.warning(mode + " does not support f64 histograms yet; "
+                            "falling back to exact growth")
+            elif cfg.tree_growth == "frontier":
+                frontier_mode = True
             else:
                 batch_splits = min(cfg.tree_batch_splits,
                                    cfg.num_leaves - 1)
@@ -461,6 +465,7 @@ class GBDT:
             batch_splits=batch_splits,
             batched_pack=(batch_splits > 0 and cfg.tpu_batched_pack),
             batched_part=batched_part,
+            frontier_mode=frontier_mode,
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
@@ -807,10 +812,13 @@ class GBDT:
                 h = h * mult[:, None]
                 sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
 
-            # one place decides which batched grower runs (the shard_map
-            # and single-device branches below both use it)
+            # one place decides which wave-batched grower runs (the
+            # shard_map and single-device branches below both use it)
             grow_batched_fn = None
-            if params.batch_splits > 0:
+            if params.frontier_mode:
+                from ..core.grow_frontier import \
+                    grow_tree_frontier as grow_batched_fn
+            elif params.batch_splits > 0:
                 if params.batched_part:
                     from ..core.grow_batched_part import \
                         grow_tree_batched_part as grow_batched_fn
@@ -867,10 +875,10 @@ class GBDT:
                 # grow_one's definedness below depends on this invariant
                 # (enforced at config time, gbdt batched gating): keep it
                 # local so relaxing that check can't unbind grow_one
-                assert not (has_cegb and params.batch_splits > 0), \
-                    "batched growth cannot carry CEGB state"
+                assert not (has_cegb and grow_batched_fn is not None), \
+                    "wave-batched growth cannot carry CEGB state"
 
-                if params.batch_splits > 0:
+                if grow_batched_fn is not None:
                     def _grow_core(xbj, gj, hj, mj, fm):
                         return grow_batched_fn(
                             xbj, gj, hj, mj, meta, fm, params,
@@ -916,7 +924,7 @@ class GBDT:
                         t, li = grow_sharded(xb, gk, hk, sample_mask,
                                              feature_mask)
                         return t, li, None
-            elif params.batch_splits > 0:
+            elif grow_batched_fn is not None:
                 def grow_one(gk, hk, cs):
                     return grow_batched_fn(xb, gk, hk, sample_mask, meta,
                                            feature_mask, params)

@@ -171,8 +171,11 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # split the top-tree_batch_splits frontier leaves per sequential step
     # (approximate best-first; amortizes TPU per-split latency — the same
     # accuracy stance as the reference GPU learner's documented deviations,
-    # GPU-Performance.rst:132-139). See core/grow_batched.py.
-    ("tree_growth", str, "exact", ["growth_mode"]),
+    # GPU-Performance.rst:132-139; core/grow_batched.py); frontier =
+    # split EVERY positive-gain frontier leaf per step with ONE batched
+    # histogram sweep per wave — O(depth) dataset sweeps per tree instead
+    # of O(num_leaves) (core/grow_frontier.py).
+    ("tree_growth", str, "exact", ["growth_mode", "tree_grow_mode"]),
     ("tree_batch_splits", int, 16, []),
     # batched growth: pack active rows so dead row tiles skip the slot
     # kernel's compute (cost ~ split-leaf rows, not N); opt-in until
@@ -203,6 +206,13 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("serve_metrics_file", str, "", []),      # JSON-lines metrics sink
     ("serve_metrics_freq", float, 10.0, []),  # seconds between snapshots
 ]
+
+# known spellings, validated in _post_process (a typo'd kernel or growth
+# mode must fail loudly at config time, not fall through to some default
+# deep in the dispatch)
+TREE_GROW_MODES = ("exact", "batched", "frontier")
+HIST_IMPLS = ("auto", "matmul", "scatter", "pallas", "pallas_highest",
+              "pallas_interpret", "pallas_highest_interpret")
 
 _CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}
 _ALIASES: Dict[str, str] = {}
@@ -378,9 +388,15 @@ class Config:
         if self.num_leaves < 2:
             raise LightGBMError("num_leaves should be >= 2")
         self.tree_growth = str(self.tree_growth).strip().lower()
-        if self.tree_growth not in ("exact", "batched"):
-            raise LightGBMError("tree_growth should be exact or batched, "
-                                "got %s" % self.tree_growth)
+        if self.tree_growth not in TREE_GROW_MODES:
+            raise LightGBMError("tree_growth should be one of %s, got %s"
+                                % ("/".join(TREE_GROW_MODES),
+                                   self.tree_growth))
+        self.tpu_hist_impl = str(self.tpu_hist_impl).strip().lower()
+        if self.tpu_hist_impl not in HIST_IMPLS:
+            raise LightGBMError("tpu_hist_impl should be one of %s, got %s"
+                                % ("/".join(HIST_IMPLS),
+                                   self.tpu_hist_impl))
         if self.tree_batch_splits < 1:
             raise LightGBMError("tree_batch_splits should be >= 1")
         self.tpu_batched_part = str(self.tpu_batched_part).strip().lower()

@@ -124,6 +124,13 @@ class GrowParams(NamedTuple):
     # slower than the kernel savings on a v5e chip, so this stays opt-in
     # (docs/Performance.md round-4 table)
     batched_part: bool = False
+    # frontier-wave growth (core/grow_frontier.py): split EVERY
+    # positive-gain frontier leaf per sequential step, with histogram
+    # construction batched into one leaf-indexed dataset pass per wave
+    # (histogram.build_histogram_frontier) — O(depth) sweeps per tree
+    # instead of O(num_leaves). Split selection stays leaf-wise/best-first
+    # within each wave (gain-ranked node numbering, like batched growth)
+    frontier_mode: bool = False
 
 
 class TreeArrays(NamedTuple):

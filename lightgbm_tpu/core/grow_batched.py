@@ -47,7 +47,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..compat import pcast
-from .histogram import build_histogram
+from .histogram import build_histogram, build_histogram_frontier
 from .grow import (GrowParams, TreeArrays, _bin_go_left, _empty_best,
                    decode_bundle_value, empty_tree, expand_hist,
                    propagate_monotone_bounds)
@@ -63,6 +63,107 @@ def _drop_set(arr: jnp.ndarray, idx: jnp.ndarray, val: jnp.ndarray,
     n = arr.shape[0]
     safe = jnp.where(cond, idx, n)
     return arr.at[safe].set(val, mode="drop")
+
+
+def interleave_lr(a: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """[K] left + [K] right per-split values -> [2K] interleaved
+    L,R,L,R,... — the child lane order of the wave-wide vmapped split
+    search (left child of rank i at lane 2i, right at 2i+1)."""
+    return jnp.stack([a, c], axis=1).reshape(-1)
+
+
+def apply_split_wave(tree: TreeArrays, leaf_min: jnp.ndarray,
+                     leaf_max: jnp.ndarray, cur, gleaf: jnp.ndarray,
+                     node: jnp.ndarray, right_leaf: jnp.ndarray,
+                     valid: jnp.ndarray, nvalid: jnp.ndarray,
+                     meta: FeatureMeta, sp, max_depth: int):
+    """Commit one wave of up to K frontier splits to the tree arrays
+    (Tree::Split x K, tree.cpp:49-67) plus monotone-bound propagation.
+
+    Every write is a scatter-with-drop, so invalid lanes touch nothing.
+    Shared by the plain batched, partitioned-batched and frontier-wave
+    growers so the wave-commit semantics cannot drift between them.
+    Returns (tree, leaf_min, leaf_max, safe_leaf, ch_min, ch_max, ch_ok)
+    with the ch_* arrays in the interleaved [2K] child lane order."""
+    l = tree.leaf_value.shape[0]
+    nl = tree.num_leaves
+    safe_leaf = jnp.where(valid, gleaf, l - 1)
+    parent_node = tree.leaf_parent[safe_leaf]                 # [K]
+    p_exists = valid & (parent_node >= 0)
+    safe_p = jnp.maximum(parent_node, 0)
+    was_left = tree.left_child[safe_p] == ~safe_leaf
+    left_child = _drop_set(tree.left_child, safe_p, node,
+                           p_exists & was_left)
+    right_child = _drop_set(tree.right_child, safe_p, node,
+                            p_exists & ~was_left)
+    left_child = _drop_set(left_child, node, ~safe_leaf, valid)
+    right_child = _drop_set(right_child, node, ~right_leaf, valid)
+
+    depth = tree.leaf_depth[safe_leaf] + 1                    # [K]
+    parent_value = calculate_leaf_output(
+        cur.left_sum_grad + cur.right_sum_grad,
+        cur.left_sum_hess + cur.right_sum_hess,
+        sp.lambda_l1, sp.lambda_l2, sp.max_delta_step)
+
+    def set_node(arr, val):
+        return _drop_set(arr, node, val, valid)
+
+    def set_leaves(arr, lval, rval):
+        return _drop_set(_drop_set(arr, safe_leaf, lval, valid),
+                         right_leaf, rval, valid)
+
+    tree = tree._replace(
+        split_feature=set_node(tree.split_feature, cur.feature),
+        threshold_bin=set_node(tree.threshold_bin, cur.threshold),
+        default_left=set_node(tree.default_left, cur.default_left),
+        missing_type=set_node(tree.missing_type,
+                              meta.missing_type[cur.feature]),
+        is_categorical=set_node(tree.is_categorical, cur.is_categorical),
+        cat_bitset=_drop_set(tree.cat_bitset, node, cur.cat_bitset,
+                             valid),
+        left_child=left_child, right_child=right_child,
+        split_gain=set_node(tree.split_gain, cur.gain),
+        internal_value=set_node(tree.internal_value, parent_value),
+        internal_weight=set_node(tree.internal_weight,
+                                 cur.left_sum_hess + cur.right_sum_hess),
+        internal_count=set_node(tree.internal_count,
+                                cur.left_count + cur.right_count),
+        split_leaf=set_node(tree.split_leaf, safe_leaf),
+        leaf_value=set_leaves(tree.leaf_value, cur.left_output,
+                              cur.right_output),
+        leaf_weight=set_leaves(tree.leaf_weight, cur.left_sum_hess,
+                               cur.right_sum_hess),
+        leaf_count=set_leaves(tree.leaf_count, cur.left_count,
+                              cur.right_count),
+        leaf_parent=set_leaves(tree.leaf_parent, node, node),
+        leaf_depth=set_leaves(tree.leaf_depth, depth, depth),
+        num_leaves=nl + nvalid)
+
+    mono = meta.monotone[cur.feature]
+    p_min, p_max = leaf_min[safe_leaf], leaf_max[safe_leaf]
+    l_min, l_max, r_min, r_max = propagate_monotone_bounds(
+        mono, cur.left_output, cur.right_output, p_min, p_max)
+    leaf_min = set_leaves(leaf_min, l_min, r_min)
+    leaf_max = set_leaves(leaf_max, l_max, r_max)
+
+    depth_ok = (max_depth <= 0) | (depth < max_depth)
+    return (tree, leaf_min, leaf_max, safe_leaf,
+            interleave_lr(l_min, r_min), interleave_lr(l_max, r_max),
+            interleave_lr(depth_ok, depth_ok))
+
+
+def scatter_child_best(best, b2k, safe_leaf: jnp.ndarray,
+                       right_leaf: jnp.ndarray, valid: jnp.ndarray):
+    """De-interleave the [2K]-lane child split search back onto the
+    per-leaf best table (left child keeps the parent's leaf index, right
+    child takes its new leaf) — drop-scattered so invalid lanes write
+    nothing. Shared by every wave-batched grower."""
+    bl = jax.tree.map(lambda a: a[0::2], b2k)
+    br = jax.tree.map(lambda a: a[1::2], b2k)
+    return jax.tree.map(
+        lambda arr, vl, vr: _drop_set(_drop_set(arr, safe_leaf, vl, valid),
+                                      right_leaf, vr, valid),
+        best, bl, br)
 
 
 def route_split_rows(xb_fm, rank, rs, onek, cur, meta, with_efb,
@@ -125,9 +226,9 @@ def _combined_hist(xb, slot, active, grad, hess, hmask, b, kb, impl,
 
     Pallas spellings use the slot-extended digit kernel (the combined
     slot*B+bin index as a third one-hot factor on the MXU); matmul/scatter
-    build over the combined index directly — fine on CPU, but a matmul
-    one-hot of width 2K*B would be enormous on device, which is exactly
-    why the slot kernel exists.
+    delegate to histogram.build_histogram_frontier, the leaf-indexed
+    frontier builder (slot one-hot x bin one-hot), with inactive rows
+    marked slot -1.
 
     ``pack`` (tpu_batched_pack): gather the ACTIVE rows (those inside a
     splitting leaf) to the front with a stable cumsum partition before
@@ -156,10 +257,9 @@ def _combined_hist(xb, slot, active, grad, hess, hmask, b, kb, impl,
             interpret=impl.endswith("interpret"),
             highest="highest" in impl)                  # [2K, C, B, 3]
         return out
-    comb = slot[:, None].astype(jnp.int32) * b + xb.astype(jnp.int32)
-    hist_all = build_histogram(comb, grad, hess, hmask, num_bins=2 * kb * b,
-                               row_chunk=row_chunk, impl=impl)
-    return jnp.moveaxis(hist_all.reshape(-1, 2 * kb, b, 3), 1, 0)
+    return build_histogram_frontier(
+        xb, jnp.where(active, slot, -1), grad, hess, hmask,
+        num_bins=b, num_slots=2 * kb, row_chunk=row_chunk, impl=impl)
 
 
 def grow_tree_batched(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -271,86 +371,19 @@ def grow_tree_batched(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 params.batched_pack))                     # [2K, C, B, 3]
 
         # ---- tree bookkeeping for up to K splits (Tree::Split, x K) -----
-        safe_leaf = jnp.where(valid, gleaf, l - 1)
-        parent_node = tree.leaf_parent[safe_leaf]         # [kb]
-        p_exists = valid & (parent_node >= 0)
-        safe_p = jnp.maximum(parent_node, 0)
-        was_left = tree.left_child[safe_p] == ~safe_leaf
-        left_child = _drop_set(tree.left_child, safe_p, node,
-                               p_exists & was_left)
-        right_child = _drop_set(tree.right_child, safe_p, node,
-                                p_exists & ~was_left)
-        left_child = _drop_set(left_child, node, ~safe_leaf, valid)
-        right_child = _drop_set(right_child, node, ~right_leaf, valid)
-
-        depth = tree.leaf_depth[safe_leaf] + 1            # [kb]
-        parent_value = calculate_leaf_output(
-            cur.left_sum_grad + cur.right_sum_grad,
-            cur.left_sum_hess + cur.right_sum_hess,
-            sp.lambda_l1, sp.lambda_l2, sp.max_delta_step)
-
-        def set_node(arr, val):
-            return _drop_set(arr, node, val, valid)
-
-        def set_leaves(arr, lval, rval):
-            return _drop_set(_drop_set(arr, safe_leaf, lval, valid),
-                             right_leaf, rval, valid)
-
-        tree = tree._replace(
-            split_feature=set_node(tree.split_feature, cur.feature),
-            threshold_bin=set_node(tree.threshold_bin, cur.threshold),
-            default_left=set_node(tree.default_left, cur.default_left),
-            missing_type=set_node(tree.missing_type,
-                                  meta.missing_type[cur.feature]),
-            is_categorical=set_node(tree.is_categorical, cur.is_categorical),
-            cat_bitset=_drop_set(tree.cat_bitset, node, cur.cat_bitset,
-                                 valid),
-            left_child=left_child, right_child=right_child,
-            split_gain=set_node(tree.split_gain, cur.gain),
-            internal_value=set_node(tree.internal_value, parent_value),
-            internal_weight=set_node(tree.internal_weight,
-                                     cur.left_sum_hess + cur.right_sum_hess),
-            internal_count=set_node(tree.internal_count,
-                                    cur.left_count + cur.right_count),
-            split_leaf=set_node(tree.split_leaf, safe_leaf),
-            leaf_value=set_leaves(tree.leaf_value, cur.left_output,
-                                  cur.right_output),
-            leaf_weight=set_leaves(tree.leaf_weight, cur.left_sum_hess,
-                                   cur.right_sum_hess),
-            leaf_count=set_leaves(tree.leaf_count, cur.left_count,
-                                  cur.right_count),
-            leaf_parent=set_leaves(tree.leaf_parent, node, node),
-            leaf_depth=set_leaves(tree.leaf_depth, depth, depth),
-            num_leaves=nl + nvalid)
-
-        mono = meta.monotone[cur.feature]
-        p_min, p_max = s.leaf_min[safe_leaf], s.leaf_max[safe_leaf]
-        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
-            mono, cur.left_output, cur.right_output, p_min, p_max)
-        leaf_min = set_leaves(s.leaf_min, l_min, r_min)
-        leaf_max = set_leaves(s.leaf_max, l_max, r_max)
+        (tree, leaf_min, leaf_max, safe_leaf,
+         ch_min, ch_max, ch_ok) = apply_split_wave(
+            tree, s.leaf_min, s.leaf_max, cur, gleaf, node, right_leaf,
+            valid, nvalid, meta, sp, params.max_depth)
 
         # ---- best splits for all 2K children, one vmapped search --------
-        def inter(a, c):
-            return jnp.stack([a, c], axis=1).reshape(-1)  # [2kb] L,R,L,R...
-
-        ch_sg = inter(cur.left_sum_grad, cur.right_sum_grad)
-        ch_sh = inter(cur.left_sum_hess, cur.right_sum_hess)
-        ch_cnt = inter(cur.left_count, cur.right_count)
-        ch_min = inter(l_min, r_min)
-        ch_max = inter(l_max, r_max)
-        depth_ok = (params.max_depth <= 0) | (depth < params.max_depth)
-        ch_ok = inter(depth_ok, depth_ok)
+        ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
+        ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
+        ch_cnt = interleave_lr(cur.left_count, cur.right_count)
         b2k = jax.vmap(child_best)(ch_hist, ch_sg, ch_sh, ch_cnt,
                                    ch_min, ch_max)
         b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
-        bl = jax.tree.map(lambda a: a[0::2], b2k)
-        br = jax.tree.map(lambda a: a[1::2], b2k)
-        best = jax.tree.map(
-            lambda arr, vl, vr: _drop_set(_drop_set(arr, safe_leaf, vl,
-                                                    valid),
-                                          right_leaf, vr, valid),
-            s.best, bl, br)
+        best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
 
         return _BatchState(leaf_id=leaf_id, best=best, tree=tree,
                            leaf_min=leaf_min, leaf_max=leaf_max)

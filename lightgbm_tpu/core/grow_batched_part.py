@@ -57,8 +57,10 @@ from jax import lax
 from ..compat import pcast
 from .histogram import build_histogram
 from .grow import (GrowParams, TreeArrays, _empty_best, empty_tree,
-                   expand_hist, propagate_monotone_bounds)
-from .grow_batched import _combined_hist, _drop_set, route_split_rows
+                   expand_hist)
+from .grow_batched import (_combined_hist, _drop_set, apply_split_wave,
+                           interleave_lr, route_split_rows,
+                           scatter_child_best)
 from .split import (BestSplit, FeatureMeta, K_MIN_SCORE,
                     calculate_leaf_output, find_best_split)
 
@@ -271,86 +273,19 @@ def grow_tree_batched_part(xb: jnp.ndarray, grad: jnp.ndarray,
         orig2 = s.orig[perm]
 
         # ---- tree bookkeeping for up to K splits (same as grow_batched) -
-        safe_leaf = jnp.where(valid, gleaf, l - 1)
-        parent_node = tree.leaf_parent[safe_leaf]
-        p_exists = valid & (parent_node >= 0)
-        safe_p = jnp.maximum(parent_node, 0)
-        was_left = tree.left_child[safe_p] == ~safe_leaf
-        left_child = _drop_set(tree.left_child, safe_p, node,
-                               p_exists & was_left)
-        right_child = _drop_set(tree.right_child, safe_p, node,
-                                p_exists & ~was_left)
-        left_child = _drop_set(left_child, node, ~safe_leaf, valid)
-        right_child = _drop_set(right_child, node, ~right_leaf, valid)
-
-        depth = tree.leaf_depth[safe_leaf] + 1
-        parent_value = calculate_leaf_output(
-            cur.left_sum_grad + cur.right_sum_grad,
-            cur.left_sum_hess + cur.right_sum_hess,
-            sp.lambda_l1, sp.lambda_l2, sp.max_delta_step)
-
-        def set_node(arr, val):
-            return _drop_set(arr, node, val, valid)
-
-        def set_leaves(arr, lval, rval):
-            return _drop_set(_drop_set(arr, safe_leaf, lval, valid),
-                             right_leaf, rval, valid)
-
-        tree = tree._replace(
-            split_feature=set_node(tree.split_feature, cur.feature),
-            threshold_bin=set_node(tree.threshold_bin, cur.threshold),
-            default_left=set_node(tree.default_left, cur.default_left),
-            missing_type=set_node(tree.missing_type,
-                                  meta.missing_type[cur.feature]),
-            is_categorical=set_node(tree.is_categorical, cur.is_categorical),
-            cat_bitset=_drop_set(tree.cat_bitset, node, cur.cat_bitset,
-                                 valid),
-            left_child=left_child, right_child=right_child,
-            split_gain=set_node(tree.split_gain, cur.gain),
-            internal_value=set_node(tree.internal_value, parent_value),
-            internal_weight=set_node(tree.internal_weight,
-                                     cur.left_sum_hess + cur.right_sum_hess),
-            internal_count=set_node(tree.internal_count,
-                                    cur.left_count + cur.right_count),
-            split_leaf=set_node(tree.split_leaf, safe_leaf),
-            leaf_value=set_leaves(tree.leaf_value, cur.left_output,
-                                  cur.right_output),
-            leaf_weight=set_leaves(tree.leaf_weight, cur.left_sum_hess,
-                                   cur.right_sum_hess),
-            leaf_count=set_leaves(tree.leaf_count, cur.left_count,
-                                  cur.right_count),
-            leaf_parent=set_leaves(tree.leaf_parent, node, node),
-            leaf_depth=set_leaves(tree.leaf_depth, depth, depth),
-            num_leaves=nl + nvalid)
-
-        mono = meta.monotone[cur.feature]
-        p_min, p_max = s.leaf_min[safe_leaf], s.leaf_max[safe_leaf]
-        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
-            mono, cur.left_output, cur.right_output, p_min, p_max)
-        leaf_min = set_leaves(s.leaf_min, l_min, r_min)
-        leaf_max = set_leaves(s.leaf_max, l_max, r_max)
+        (tree, leaf_min, leaf_max, safe_leaf,
+         ch_min, ch_max, ch_ok) = apply_split_wave(
+            tree, s.leaf_min, s.leaf_max, cur, gleaf, node, right_leaf,
+            valid, nvalid, meta, sp, params.max_depth)
 
         # ---- best splits for all 2K children, one vmapped search --------
-        def inter(a, c):
-            return jnp.stack([a, c], axis=1).reshape(-1)
-
-        ch_sg = inter(cur.left_sum_grad, cur.right_sum_grad)
-        ch_sh = inter(cur.left_sum_hess, cur.right_sum_hess)
-        ch_cnt = inter(cur.left_count, cur.right_count)
-        ch_min = inter(l_min, r_min)
-        ch_max = inter(l_max, r_max)
-        depth_ok = (params.max_depth <= 0) | (depth < params.max_depth)
-        ch_ok = inter(depth_ok, depth_ok)
+        ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
+        ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
+        ch_cnt = interleave_lr(cur.left_count, cur.right_count)
         b2k = jax.vmap(child_best)(ch_hist, ch_sg, ch_sh, ch_cnt,
                                    ch_min, ch_max)
         b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
-        bl = jax.tree.map(lambda a: a[0::2], b2k)
-        br = jax.tree.map(lambda a: a[1::2], b2k)
-        best = jax.tree.map(
-            lambda arr, vl, vr: _drop_set(_drop_set(arr, safe_leaf, vl,
-                                                    valid),
-                                          right_leaf, vr, valid),
-            s.best, bl, br)
+        best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
 
         return _PartState(
             xb_fm=xb_fm2, vals3=vals3_2, row_leaf=row_leaf2, orig=orig2,

@@ -1,0 +1,232 @@
+"""Frontier-wave tree growth: O(depth) dataset sweeps per tree.
+
+grow_tree (exact) rebuilds ONE leaf's histogram per loop iteration, so a
+255-leaf tree pays ~254 serial sweeps over (half of) the dataset — the
+dominant cost in the round-5 bench (partition_hist_fused ~86 ms +
+hist_leaf_half ~17 ms per split step on CPU). Both GPU GBDT papers in
+PAPERS.md (arXiv:1706.08359, arXiv:1806.11248) fix this the same way:
+build the histograms of EVERY active node of a level in a single
+node-indexed pass over the data. This module is that schedule:
+
+- split selection stays leaf-wise / best-first WITHIN each wave: every
+  frontier leaf whose best split has positive gain is committed, ranked
+  by gain (rank i -> node nl-1+i, right leaf nl+i — the same numbering
+  as grow_batched, and tree.cpp:49-67 when one leaf splits);
+- histogram construction is batched per wave: ONE leaf-indexed pass
+  (histogram.build_histogram_frontier) produces the [K, F, B, 3] tensor
+  for every split's SMALLER child at once, and the larger sibling is
+  derived by the subtraction trick from a per-leaf histogram pool that
+  survives across waves — so a tree costs O(max leaf depth) ~ 8-12
+  dataset sweeps instead of O(num_leaves) ~ 254;
+- the sharded path psums the batched [K, F, B, 3] tensor ONCE per wave
+  instead of once per leaf.
+
+Routing differs from grow_batched.route_split_rows on purpose: that
+helper materializes a [K, N] one-hot so per-STEP routing costs no
+per-row gathers — the right trade at K<=32 where the one-hot is cheap
+and steps are many. Here K = num_leaves - 1 (every leaf can split), so a
+[K, N] one-hot would be O(L*N) per wave; instead each row gathers its
+own split's parameters (~6 per-row gathers per WAVE), which runs
+O(depth) times per tree, not O(num_leaves) times.
+
+Semantics: splitting every positive-gain frontier leaf is exactly the
+set of splits exact best-first performs when the num_leaves cap never
+binds (each leaf's best split depends only on its own rows and its
+ancestors' monotone bounds), so the grown PARTITION is identical there —
+tested in tests/test_grow_frontier.py. Near the cap the wave commits
+gain-ranked until the cap, which can differ from fully-serial re-ranking
+(same documented approximation as grow_batched at K>1). Forced splits
+and CEGB keep the exact path (order-dependent accounting), same as
+grow_batched.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..compat import pcast
+from .histogram import build_histogram, build_histogram_frontier
+from .grow import (GrowParams, TreeArrays, _bin_go_left, _empty_best,
+                   decode_bundle_value, empty_tree, expand_hist)
+from .grow_batched import (_drop_set, apply_split_wave, interleave_lr,
+                           scatter_child_best)
+from .split import (FeatureMeta, K_MIN_SCORE, calculate_leaf_output,
+                    find_best_split)
+
+
+class _FrontierState(NamedTuple):
+    leaf_id: jnp.ndarray      # [N] int32
+    hist_pool: jnp.ndarray    # [L, C, B, 3] per-leaf histograms
+    best: jnp.ndarray         # per-leaf best split, fields [L] (BestSplit)
+    tree: TreeArrays
+    leaf_min: jnp.ndarray     # [L] f32 monotone lower bound
+    leaf_max: jnp.ndarray     # [L] f32 monotone upper bound
+
+
+def _route_rows_gather(xb, rs, cur, meta, with_efb, with_categorical):
+    """Per-row go-left decisions for the wave's splits via per-row
+    gathers of each row's split descriptor (see module docstring for why
+    this is gather-based where route_split_rows is one-hot-based).
+
+    xb: [N, C] row-major bins; rs: [N] clamped per-row split rank;
+    cur: BestSplit fields [K]. Returns go_left [N] bool (garbage on rows
+    whose leaf is not splitting — callers mask with ``active``)."""
+    fk = cur.feature[rs]                                     # [N]
+    stored_col = (meta.col[fk] if with_efb else fk).astype(jnp.int32)
+    colv = jnp.take_along_axis(
+        xb, stored_col[:, None], axis=1)[:, 0].astype(jnp.int32)
+    num_bin_r = meta.num_bin[fk]
+    default_bin_r = meta.default_bin[fk]
+    if with_efb:
+        fbin = decode_bundle_value(
+            colv, meta.offset[fk], num_bin_r, default_bin_r,
+            pack_div=(meta.pack_div[fk]
+                      if meta.pack_div is not None else None),
+            pack_mod=(meta.pack_mod[fk]
+                      if meta.pack_mod is not None else None))
+    else:
+        fbin = colv
+    return _bin_go_left(
+        fbin, cur.threshold[rs], cur.default_left[rs],
+        meta.missing_type[fk], num_bin_r, default_bin_r,
+        (cur.is_categorical[rs] if with_categorical else None),
+        (cur.cat_bitset[rs] if with_categorical else None))
+
+
+def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
+                       hess: jnp.ndarray, sample_mask: jnp.ndarray,
+                       meta: FeatureMeta, feature_mask: jnp.ndarray,
+                       params: GrowParams,
+                       axis_name: Optional[str] = None,
+                       ) -> Tuple[TreeArrays, jnp.ndarray, None]:
+    """Grow one tree in frontier waves: every positive-gain frontier
+    leaf splits per sequential step, with ONE batched histogram pass per
+    wave. Same contract as grow.grow_tree (minus forced/CEGB); returns
+    (tree, final per-row leaf_id, None)."""
+    n, ncols = xb.shape
+    l = params.num_leaves
+    b = params.num_bins
+    sp = params.split
+    kb = l - 1                     # wave width: any frontier leaf can split
+    with_efb = params.with_efb
+
+    def psum(x):
+        return lax.psum(x, axis_name) if axis_name is not None else x
+
+    def child_best(hist_col, sum_g, sum_h, cnt, min_c, max_c):
+        return find_best_split(
+            expand_hist(hist_col, sum_g, sum_h, cnt, meta, params, ncols),
+            meta, sp, sum_g, sum_h, cnt, feature_mask,
+            min_constraint=min_c, max_constraint=max_c,
+            with_categorical=params.with_categorical)
+
+    # ---- root (identical to exact mode) ---------------------------------
+    sample_mask = sample_mask.astype(jnp.float32)
+    root_g = psum(jnp.sum(grad * sample_mask))
+    root_h = psum(jnp.sum(hess * sample_mask))
+    root_c = psum(jnp.sum(sample_mask))
+    hist_root = psum(build_histogram(xb, grad, hess, sample_mask, num_bins=b,
+                                     row_chunk=params.row_chunk,
+                                     impl=params.hist_impl))
+    tree = empty_tree(l)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(
+            calculate_leaf_output(root_g, root_h, sp.lambda_l1, sp.lambda_l2,
+                                  sp.max_delta_step)),
+        leaf_weight=tree.leaf_weight.at[0].set(root_h),
+        leaf_count=tree.leaf_count.at[0].set(root_c))
+    best0 = child_best(hist_root, root_g, root_h, root_c, -jnp.inf, jnp.inf)
+    best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
+
+    # per-leaf histogram pool: a frontier leaf's histogram survives from
+    # the wave that created it, so the subtraction trick works wave-wide
+    # (parent - smaller child = larger child; histogram.cpp:xx Subtract)
+    hist_pool = jnp.zeros((l, ncols, b, 3), jnp.float32).at[0].set(hist_root)
+
+    leaf_id0 = jnp.zeros((n,), jnp.int32)
+    if axis_name is not None:
+        leaf_id0 = pcast(leaf_id0, (axis_name,), to="varying")
+    state = _FrontierState(
+        leaf_id=leaf_id0, hist_pool=hist_pool, best=best, tree=tree,
+        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
+        leaf_max=jnp.full((l,), jnp.inf, jnp.float32))
+
+    def cond_fn(s: _FrontierState) -> jnp.ndarray:
+        return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
+
+    def step(s: _FrontierState) -> _FrontierState:
+        tree = s.tree
+        nl = tree.num_leaves                      # dynamic scalar
+        rank = jnp.arange(kb, dtype=jnp.int32)
+        gval, gleaf = lax.top_k(s.best.gain, kb)  # distinct leaves, desc
+        # the whole positive-gain frontier splits, gain-ranked; both
+        # conditions are prefix masks of the sorted ranks
+        valid = (gval > 0.0) & (rank < (l - nl))
+        nvalid = jnp.sum(valid.astype(jnp.int32))
+        node = (nl - 1) + rank                    # [kb]
+        right_leaf = nl + rank                    # [kb]
+        cur = jax.tree.map(lambda a: a[gleaf], s.best)   # fields [kb]
+
+        # ---- route every row through its leaf's split -------------------
+        rank_of_leaf = jnp.full((l,), -1, jnp.int32)
+        rank_of_leaf = _drop_set(rank_of_leaf, gleaf, rank, valid)
+        r_r = rank_of_leaf[s.leaf_id]             # [N], -1 = not splitting
+        active = r_r >= 0
+        rs = jnp.maximum(r_r, 0)
+        go_left = _route_rows_gather(xb, rs, cur, meta, with_efb,
+                                     params.with_categorical)
+        leaf_id = jnp.where(active & ~go_left, right_leaf[rs], s.leaf_id)
+
+        # ---- ONE dataset sweep: smaller child of every split ------------
+        # slot = split rank iff the row lands in the SMALLER child of its
+        # leaf's split, else -1 (inactive); the larger sibling is derived
+        # from the pool by subtraction, so the sweep touches each
+        # splitting row at most once and the wave costs one pass total
+        left_small = cur.left_count <= cur.right_count       # [kb]
+        in_small = active & (go_left == left_small[rs])
+        slot = jnp.where(in_small, rs, -1)
+        hist_small = psum(build_histogram_frontier(
+            xb, slot, grad, hess, sample_mask, num_bins=b, num_slots=kb,
+            row_chunk=params.row_chunk,
+            impl=params.hist_impl))                # [kb, C, B, 3]
+
+        parent_hist = s.hist_pool[jnp.where(valid, gleaf, 0)]
+        hist_large = parent_hist - hist_small
+        ls = left_small[:, None, None, None]
+        hist_left = jnp.where(ls, hist_small, hist_large)
+        hist_right = jnp.where(ls, hist_large, hist_small)
+
+        # pool update: left child reuses the parent's leaf index, right
+        # child takes its new leaf; invalid lanes drop
+        pool = s.hist_pool
+        pool = pool.at[jnp.where(valid, gleaf, l)].set(
+            hist_left, mode="drop")
+        pool = pool.at[jnp.where(valid, right_leaf, l)].set(
+            hist_right, mode="drop")
+
+        # ---- tree bookkeeping for the wave (shared with grow_batched) ---
+        (tree, leaf_min, leaf_max, safe_leaf,
+         ch_min, ch_max, ch_ok) = apply_split_wave(
+            tree, s.leaf_min, s.leaf_max, cur, gleaf, node, right_leaf,
+            valid, nvalid, meta, sp, params.max_depth)
+
+        # ---- best splits for all 2K children, one vmapped search --------
+        ch_hist = jnp.stack([hist_left, hist_right],
+                            axis=1).reshape(2 * kb, ncols, b, 3)
+        ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
+        ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
+        ch_cnt = interleave_lr(cur.left_count, cur.right_count)
+        b2k = jax.vmap(child_best)(ch_hist, ch_sg, ch_sh, ch_cnt,
+                                   ch_min, ch_max)
+        b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
+        best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
+
+        return _FrontierState(leaf_id=leaf_id, hist_pool=pool, best=best,
+                              tree=tree, leaf_min=leaf_min,
+                              leaf_max=leaf_max)
+
+    state = lax.while_loop(cond_fn, step, state)
+    return state.tree, state.leaf_id, None

@@ -119,6 +119,115 @@ def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     return hist
 
 
+def _frontier_scatter(xb: jnp.ndarray, slot: jnp.ndarray, vals: jnp.ndarray,
+                      num_bins: int, num_slots: int) -> jnp.ndarray:
+    """Leaf-indexed segment scatter: one combined (slot, feature, bin)
+    index per row-feature, one scatter-add over the whole dataset.
+    Rows with slot -1 are deactivated by zeroing their value channels (the
+    clamped slot-0 writes then add zeros)."""
+    n, f = xb.shape
+    k = vals.shape[-1]
+    active = slot >= 0
+    vals = vals * active[:, None].astype(vals.dtype)
+    s_c = jnp.where(active, slot, 0).astype(jnp.int32)
+    flat = (s_c[:, None] * f + jnp.arange(f, dtype=jnp.int32)[None, :]) \
+        * num_bins + xb.astype(jnp.int32)
+    hist = jnp.zeros((num_slots * f * num_bins, k), dtype=vals.dtype)
+    hist = hist.at[flat.reshape(-1)].add(
+        jnp.broadcast_to(vals[:, None, :], (n, f, k)).reshape(n * f, k))
+    return hist.reshape(num_slots, f, num_bins, k)
+
+
+def _frontier_chunk_matmul(xb_chunk: jnp.ndarray, slot_chunk: jnp.ndarray,
+                           vals_chunk: jnp.ndarray, num_bins: int,
+                           num_slots: int) -> jnp.ndarray:
+    """One row chunk of the (leaf, bin) one-hot MXU path: the slot one-hot
+    spreads each row's value channels into its slot's lane group, then one
+    bin-one-hot contraction prices every (slot, feature, bin) cell:
+
+        hist[s, f, b, k] = sum_c onehot(bin)[c, f, b] * onehot(slot x val)[c, s, k]
+
+    Each row lands in exactly one slot, so this pays num_slots x the MXU
+    work of a plain histogram — the price of batching a whole frontier
+    wave into one pass (the Pallas slot kernel removes the redundancy on
+    real devices). slot -1 matches no one-hot column, deactivating the row.
+    """
+    c, f = xb_chunk.shape
+    k = vals_chunk.shape[-1]
+    onehot_s = (slot_chunk[:, None] == jnp.arange(num_slots, dtype=jnp.int32)
+                ).astype(vals_chunk.dtype)                     # [C, S]
+    svals = (onehot_s[:, :, None] * vals_chunk[:, None, :]
+             ).reshape(c, num_slots * k)                       # [C, S*K]
+    onehot_b = (xb_chunk[:, :, None]
+                == jnp.arange(num_bins, dtype=xb_chunk.dtype)
+                ).astype(vals_chunk.dtype)                     # [C, F, B]
+    out = lax.dot_general(onehot_b, svals, (((0,), (0,)), ((), ())),
+                          precision=lax.Precision.HIGHEST)     # [F, B, S*K]
+    return jnp.moveaxis(out.reshape(f, num_bins, num_slots, k), 2, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "num_slots",
+                                             "row_chunk", "impl"))
+def build_histogram_frontier(xb: jnp.ndarray, slot: jnp.ndarray,
+                             grad: jnp.ndarray, hess: jnp.ndarray,
+                             mask: jnp.ndarray, num_bins: int, num_slots: int,
+                             row_chunk: int = 16384,
+                             impl: str = "matmul") -> jnp.ndarray:
+    """Histograms for EVERY live frontier leaf in ONE pass over the rows.
+
+    The multi-leaf generalization of build_histogram (the level-indexed
+    pass of the GPU GBDT literature — arXiv:1706.08359 §4, arXiv:1806.11248
+    §3.2): instead of sweeping the dataset once per leaf, every row carries
+    its leaf's frontier slot and one fused pass produces the whole wave's
+    [num_slots, F, B, 3] tensor. A tree then costs O(depth) dataset sweeps
+    instead of O(num_leaves).
+
+    Args:
+      xb: [N, F] binned features (uint8).
+      slot: [N] int32 frontier slot in [0, num_slots), or -1 for rows in no
+        frontier leaf (excluded from every slot).
+      grad, hess, mask: [N] f32, same contract as build_histogram.
+      num_bins, num_slots: static sizes.
+      impl: "matmul" ((leaf, bin) one-hot MXU contraction) | "scatter"
+        (combined-index scatter-add) | pallas spellings (the slot kernel,
+        histogram_pallas.build_histogram_frontier_pallas).
+
+    Returns: [num_slots, F, B, 3] f32 (sum_grad, sum_hess, count).
+    """
+    n, f = xb.shape
+    if impl.startswith("pallas"):
+        from .histogram_pallas import build_histogram_frontier_pallas
+        vals = jnp.stack([grad * mask, hess * mask, mask], axis=0)  # [3, N]
+        return build_histogram_frontier_pallas(
+            xb, slot, vals, num_bins=num_bins, n_slots=num_slots,
+            interpret=impl.endswith("interpret"),
+            highest="highest" in impl)
+    vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)     # [N, 3]
+    if impl == "scatter":
+        return _frontier_scatter(xb, slot, vals, num_bins, num_slots)
+    slot = slot.astype(jnp.int32)
+    if n <= row_chunk:
+        return _frontier_chunk_matmul(xb, slot, vals, num_bins, num_slots)
+    num_chunks = (n + row_chunk - 1) // row_chunk
+    pad = num_chunks * row_chunk - n
+    if pad:
+        xb = jnp.pad(xb, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        slot = jnp.pad(slot, (0, pad), constant_values=-1)
+
+    def step(acc, chunk):
+        xbc, sc, vc = chunk
+        return acc + _frontier_chunk_matmul(xbc, sc, vc, num_bins,
+                                            num_slots), None
+
+    init = jnp.zeros((num_slots, f, num_bins, 3), dtype=vals.dtype)
+    hist, _ = lax.scan(step, init,
+                       (xb.reshape(num_chunks, row_chunk, f),
+                        slot.reshape(num_chunks, row_chunk),
+                        vals.reshape(num_chunks, row_chunk, 3)))
+    return hist
+
+
 def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
     """Histogram subtraction trick: sibling = parent - child
     (FeatureHistogram::Subtract, feature_histogram.hpp:67-75)."""

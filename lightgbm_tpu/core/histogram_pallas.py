@@ -478,3 +478,26 @@ def build_histogram_slots(xb: jnp.ndarray, slot: jnp.ndarray,
     out = jnp.transpose(out, (3, 1, 2, 4, 0)).reshape(
         n_slots, fp, hi_n * 16, k)
     return out[:, :f, :num_bins]
+
+
+def build_histogram_frontier_pallas(xb: jnp.ndarray, slot: jnp.ndarray,
+                                    vals: jnp.ndarray, num_bins: int,
+                                    n_slots: int, row_tile: int = 2048,
+                                    feature_tile: int = 8,
+                                    interpret: bool = False,
+                                    highest: bool = False) -> jnp.ndarray:
+    """Frontier-wave entry of the slot kernel: the device path of
+    histogram.build_histogram_frontier.
+
+    One frontier wave's histograms — [n_slots, F, B, K] with slot = the
+    row's frontier rank (-1 = row in no splitting leaf) — ARE the slot
+    kernel's contract, so this is a named alias of build_histogram_slots:
+    the digit-factorized MXU contraction with a per-tile slot one-hot as
+    the third factor, all-inactive row tiles skipping their compute body.
+    Kept as its own entry so the frontier grower's kernel dependency is
+    explicit and its tiling defaults can diverge from the batched grower's
+    without touching that path."""
+    return build_histogram_slots(
+        xb, slot, vals, num_bins=num_bins, n_slots=n_slots,
+        row_tile=row_tile, feature_tile=feature_tile,
+        interpret=interpret, highest=highest)

@@ -333,3 +333,34 @@ def leaf_id_from_partition(part: RowPartition, num_data: int,
     rows = jnp.minimum(part.order[:num_data], num_data - 1)
     return jnp.zeros((num_data,), jnp.int32).at[rows].set(
         pos_leaf, mode="promise_in_bounds")
+
+
+def frontier_slots_from_partition(part: RowPartition, leaves: jnp.ndarray,
+                                  num_data: int) -> jnp.ndarray:
+    """Per-row frontier slot from the row partition: rows inside
+    ``leaves[i]``'s range get slot i, every other row -1.
+
+    This is the hand-off from the partition to
+    histogram.build_histogram_frontier — the partition gives the builder
+    the wave's LEAF IDS and the builder sweeps the dataset once for all
+    of them, instead of extracting one leaf's row list per histogram.
+    Same searchsorted-over-sorted-begins shape as leaf_id_from_partition,
+    except the selected leaves cover only PART of [0, num_data), so a
+    positional hit also range-checks against the owning leaf's count.
+    """
+    k = leaves.shape[0]
+    leaf_begin = part.leaf_begin[leaves]
+    leaf_count = part.leaf_count[leaves]
+    # empty/unselected ranges sort past every real one
+    begins = jnp.where(leaf_count > 0, leaf_begin, jnp.int32(num_data + 1))
+    sort_begins, sort_slot = lax.sort(
+        (begins, jnp.arange(k, dtype=jnp.int32)), num_keys=1)
+    pos = jnp.arange(num_data, dtype=jnp.int32)
+    block = jnp.searchsorted(sort_begins, pos, side="right") - 1
+    cand = sort_slot[jnp.clip(block, 0, k - 1)]
+    inside = ((block >= 0) & (pos >= leaf_begin[cand])
+              & (pos < leaf_begin[cand] + leaf_count[cand]))
+    pos_slot = jnp.where(inside, cand, -1)
+    rows = jnp.minimum(part.order[:num_data], num_data - 1)
+    return jnp.full((num_data,), -1, jnp.int32).at[rows].set(
+        pos_slot, mode="promise_in_bounds")

@@ -44,10 +44,13 @@ def latency_summary(samples_ms) -> Dict[str, float]:
 def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     """Per-phase seconds for one boosting iteration's building blocks, using
     the booster's actual data/shapes. Keys: grad, hist_full,
-    partition_hist_fused, hist_leaf_half, find_split."""
+    partition_hist_fused, hist_leaf_half, find_split, plus frontier_hist /
+    frontier_waves / frontier_sweeps_per_tree when the booster grows in
+    frontier mode (docs/Performance.md describes each)."""
     from .core.histogram import build_histogram
-    from .core.partition import (hist_for_leaf, init_partition,
-                                 make_row_gather, partition_and_hist,
+    from .core.partition import (frontier_slots_from_partition, hist_for_leaf,
+                                 init_partition, make_row_gather,
+                                 partition_and_hist,
                                  sort_placement_profitable, stack_vals)
     from .core.split import find_best_split
 
@@ -84,7 +87,11 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
                                impl=params.hist_impl)
 
         part = init_partition(n, params.num_leaves, params.row_chunk)
-        half = jnp.asarray(np.arange(n, dtype=np.int64) % 2 == 0)
+        # sized to the partition TILE, not n: the decision closure below
+        # is sliced per row tile, which is row_chunk wide even when the
+        # dataset is smaller
+        half = jnp.asarray(
+            np.arange(max(n, params.row_chunk), dtype=np.int64) % 2 == 0)
         # probe in f32 regardless of ambient x64: the gather closure owns
         # the packed bins/values boundary, so dtypes must be consistent
         gr = make_row_gather(
@@ -107,6 +114,36 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
             jax.jit(lambda p: hist_for_leaf(
                 p, jnp.int32(0), gr, n, ncols, params.num_bins,
                 params.row_chunk, impl=params.hist_impl)), part2)
+
+        if getattr(params, "frontier_mode", False):
+            from .core.histogram import build_histogram_frontier
+            # the frontier wave cost: the partition hands the builder the
+            # wave's LEAF IDS and one leaf-indexed sweep prices them all —
+            # probed at full wave width (every leaf can split)
+            n_slots = max(params.num_leaves - 1, 1)
+            slots = frontier_slots_from_partition(
+                part2, jnp.arange(n_slots, dtype=jnp.int32), n)
+            out["frontier_hist"] = _timed(
+                build_histogram_frontier, xb, slots, g, h, mask,
+                num_bins=params.num_bins, num_slots=n_slots,
+                row_chunk=params.row_chunk, impl=params.hist_impl)
+            # dataset sweeps per tree scale with DEPTH, not leaf count:
+            # wave w splits the leaves created in wave w-1, so waves = max
+            # leaf depth of the grown tree, sweeps = waves + 1 (the root)
+            if booster.models:
+                t0 = booster.models[0]
+                waves = 0
+                stack = [(0, 1)] if t0.num_leaves > 1 else []
+                while stack:
+                    nd, d = stack.pop()
+                    for ch in (int(t0.left_child[nd]),
+                               int(t0.right_child[nd])):
+                        if ch < 0:       # ~leaf encoding: negative = leaf
+                            waves = max(waves, d)
+                        else:
+                            stack.append((ch, d + 1))
+                out["frontier_waves"] = float(waves)
+                out["frontier_sweeps_per_tree"] = float(waves + 1)
 
         sum_g = jnp.sum(g)
         sum_h = jnp.sum(h)
