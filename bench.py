@@ -266,7 +266,15 @@ def run_bench(backend_info: dict) -> dict:
     if os.environ.get("BENCH_PHASES", "1") != "0":
         try:
             from lightgbm_tpu.profiling import phase_probe
+            # includes checkpoint_save_s / checkpoint_restore_s: the cost
+            # of one full-state preemption snapshot (lightgbm_tpu
+            # .checkpoint) next to the training phases it steals time from
             phases = phase_probe(b)
+            if "checkpoint_save_s" in phases and dt > 0:
+                # one snapshot as a fraction of a 5-iteration train window
+                # (the acceptance bar: default-period overhead < 5%)
+                phases["checkpoint_save_vs_train5"] = round(
+                    phases["checkpoint_save_s"] / (5.0 * dt / iters), 5)
         except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
             phases = {"probe_error": str(e)[:200]}
     # MFU estimate (BASELINE.md roofline denominator): the digit-factorized

@@ -38,4 +38,9 @@ def __getattr__(name):
                 "reset_parameter"):
         from . import callback
         return getattr(callback, name)
+    # NOTE: the checkpoint *callback factory* lives at callback.checkpoint;
+    # `lightgbm_tpu.checkpoint` is the subsystem package itself
+    if name == "CheckpointManager":
+        from .checkpoint import CheckpointManager
+        return CheckpointManager
     raise AttributeError("module 'lightgbm_tpu' has no attribute %r" % name)

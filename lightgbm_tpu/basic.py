@@ -438,6 +438,10 @@ class Booster:
             self._impl.num_init_iteration = (
                 len(init_models) // max(self._impl.num_tree_per_iteration, 1))
             self._impl.iter_ = self._impl.num_init_iteration
+            # a bare init_model carries trees only — warn loudly when the
+            # boosting mode has sampling/weight state that a model file
+            # cannot restore (checkpoints can: docs/Checkpointing.md)
+            self._impl.warn_lossy_continuation()
         self.train_set_name = "training"
 
     def _init_from_string(self, model_str: str) -> None:

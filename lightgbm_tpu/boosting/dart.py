@@ -32,6 +32,40 @@ class DART(GBDT):
         # stop-rollback path; flush each iteration.
         self._flush_every = 1
 
+    # ------------------------------------------------- checkpoint state
+    def training_state(self):
+        from ..checkpoint import snapshot as snap_mod
+        meta, arrays = super().training_state()
+        drop_meta, drop_keys = snap_mod.rng_state_split(self._drop_rng)
+        # JSON float round-trips are exact (repr/shortest-roundtrip), so
+        # tree_weight/sum_weight come back bit-identical
+        meta["dart"] = {"rng": drop_meta,
+                        "tree_weight": [float(w) for w in self.tree_weight],
+                        "sum_weight": float(self.sum_weight)}
+        arrays["dart_rng_keys"] = drop_keys
+        return meta, arrays
+
+    def load_training_state(self, meta, arrays) -> None:
+        from ..checkpoint import snapshot as snap_mod
+        super().load_training_state(meta, arrays)
+        d = meta.get("dart")
+        if d is not None and "dart_rng_keys" in arrays:
+            self._drop_rng.set_state(
+                snap_mod.rng_state_join(d["rng"], arrays["dart_rng_keys"]))
+            self.tree_weight = [float(w) for w in d["tree_weight"]]
+            self.sum_weight = float(d["sum_weight"])
+
+    def warn_lossy_continuation(self) -> None:
+        from ..log import Log
+        Log.warning(
+            "Continued DART training from init_model: the drop-set "
+            "RandomState and per-tree weights cannot be reconstructed from "
+            "a model file, so dropping probabilities restart from scratch "
+            "and results WILL diverge from an uninterrupted run. Use "
+            "checkpoints (engine.train(resume_from=<dir>)) for exact "
+            "continuation.")
+        super().warn_lossy_continuation()
+
     def _dropping_trees(self) -> List[int]:
         """Select iteration indices to drop (dart.hpp DroppingTrees:88-139)."""
         cfg = self.config

@@ -1128,6 +1128,120 @@ class GBDT:
         self._stopped_dev = jnp.asarray(False)
         self._models = list(value)
 
+    # ------------------------------------------------- checkpoint state
+    def training_state(self):
+        """Complete mutable training state as ``(meta, arrays)`` — the
+        checkpoint subsystem's capture point (lightgbm_tpu.checkpoint).
+
+        ``meta`` is JSON-safe scalars (iteration cursors, RNG cursors,
+        tree shape lists); ``arrays`` is numpy payloads (raw HostTree
+        fields, f32 scores, PRNGKey, Mersenne-Twister keys, valid-set
+        score caches, CEGB leaves). Restoring these verbatim — instead of
+        replaying trees — is what keeps a resumed run bit-identical.
+        """
+        from ..checkpoint import snapshot as snap_mod
+        self._materialize()
+        meta: Dict[str, Any] = {
+            "boosting_type": self.boosting_type,
+            "iteration": int(self.iter_),
+            "num_init_iteration": int(self.num_init_iteration),
+            "stopped": bool(self._stopped),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "boost_from_average_done": bool(self.boost_from_average_done),
+        }
+        arrays: Dict[str, np.ndarray] = {
+            "scores": np.asarray(self.scores),
+            "bag_key": np.asarray(self._bag_key),
+            "bag_mask": np.asarray(self._bag_mask),
+            "stopped_dev": np.asarray(self._stopped_dev),
+        }
+        ff_meta, ff_keys = snap_mod.rng_state_split(self._rng)
+        meta["ff_rng"] = ff_meta
+        arrays["ff_rng_keys"] = ff_keys
+        inits = getattr(self, "init_score_offsets", None)
+        if inits is not None:
+            arrays["init_score_offsets"] = np.asarray(inits)
+        if self._cegb_state is not None:
+            for j, leaf in enumerate(
+                    jax.tree_util.tree_leaves(self._cegb_state)):
+                arrays["cegb_%d" % j] = np.asarray(leaf)
+        for vi, cache in self._valid_pred_cache.items():
+            arrays["valid%d_scores" % vi] = np.asarray(cache["scores"])
+        tree_meta, tree_arrays = snap_mod.trees_to_arrays(self._models)
+        meta["trees"] = tree_meta
+        arrays.update(tree_arrays)
+        return meta, arrays
+
+    def load_training_state(self, meta, arrays) -> None:
+        """Inverse of training_state; the driver must have been built with
+        the same config/data (checkpoint.snapshot.check_compatibility)."""
+        from ..checkpoint import snapshot as snap_mod
+        # property setter clears pending work and the stop latches
+        self.models = snap_mod.trees_from_arrays(meta["trees"], arrays)
+        self.iter_ = int(meta["iteration"])
+        self.num_init_iteration = int(meta["num_init_iteration"])
+        self.shrinkage_rate = float(meta["shrinkage_rate"])
+        self.boost_from_average_done = bool(meta["boost_from_average_done"])
+        self._stopped = bool(meta["stopped"])
+        self._stopped_dev = (jnp.asarray(bool(arrays["stopped_dev"]))
+                             if "stopped_dev" in arrays
+                             else jnp.asarray(self._stopped))
+        scores = jnp.asarray(np.asarray(arrays["scores"], np.float32))
+        if self.mesh is not None:
+            from ..parallel import mesh as mesh_mod
+            scores = jax.device_put(
+                scores, mesh_mod.row_sharding(self.mesh, extra_dims=1))
+        self.scores = scores
+        self._bag_key = jnp.asarray(arrays["bag_key"], dtype=jnp.uint32)
+        self._bag_mask = jnp.asarray(arrays["bag_mask"], dtype=jnp.float32)
+        self._rng.set_state(snap_mod.rng_state_join(meta["ff_rng"],
+                                                    arrays["ff_rng_keys"]))
+        if "init_score_offsets" in arrays:
+            self.init_score_offsets = np.asarray(
+                arrays["init_score_offsets"], np.float32)
+        if self._cegb_state is not None and "cegb_0" in arrays:
+            leaves, treedef = jax.tree_util.tree_flatten(self._cegb_state)
+            self._cegb_state = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(arrays["cegb_%d" % j])
+                          for j in range(len(leaves))])
+        k = self.num_tree_per_iteration
+        for vi, cache in self._valid_pred_cache.items():
+            key = "valid%d_scores" % vi
+            if key in arrays:
+                # verbatim restore: bit-identical eval history on resume
+                cache["scores"] = jnp.asarray(
+                    np.asarray(arrays[key], np.float32))
+            else:
+                Log.warning(
+                    "checkpoint has no score cache for validation set %d "
+                    "(added after the snapshot was written?); replaying "
+                    "trees — eval values may differ in the last ulp", vi)
+                for i, ht in enumerate(self._models):
+                    leaf = self._replay_leaves_binned(ht, cache["xb"])
+                    cache["scores"] = cache["scores"].at[:, i % k].add(
+                        jnp.asarray(ht.leaf_value.astype(np.float32))[leaf])
+
+    def warn_lossy_continuation(self) -> None:
+        """Warn loudly when continued training from a bare ``init_model``
+        silently restarts sampling state from the seeds (the trees survive
+        the model file; the RNG cursors do not). Checkpoint resume
+        (engine.train(resume_from=...)) restores them exactly."""
+        cfg = self.config
+        lost = []
+        if cfg.bagging_freq > 0 and 0.0 < cfg.bagging_fraction < 1.0:
+            lost.append("bagging PRNGKey")
+        if cfg.feature_fraction < 1.0:
+            lost.append("feature_fraction RandomState")
+        if self.boosting_type == "goss":
+            lost.append("GOSS sampling key")
+        if lost:
+            Log.warning(
+                "Continued training from init_model: %s restart(s) from "
+                "the configured seed(s), so results WILL diverge from an "
+                "uninterrupted run. Use checkpoints "
+                "(engine.train(resume_from=<dir>)) for exact continuation.",
+                ", ".join(lost))
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp TrainOneIter:333-412).

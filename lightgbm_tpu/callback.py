@@ -170,8 +170,41 @@ class _EarlyStopping:
                 "best": float("-inf") if bigger_better else float("inf"),
                 "better": (lambda a, b: a > b) if bigger_better
                           else (lambda a, b: a < b),
+                "bigger_better": bool(bigger_better),
                 "best_iter": 0,
                 "best_entries": None,
+            })
+
+    # ------------------------------------------------ checkpoint support
+    def get_state(self) -> Optional[List[dict]]:
+        """JSON-safe snapshot of the per-metric slots (the ``better``
+        comparators are rebuilt from ``bigger_better`` on restore)."""
+        if not self.enabled:
+            return None
+        return [{"best": slot["best"],
+                 "bigger_better": slot["bigger_better"],
+                 "best_iter": slot["best_iter"],
+                 "best_entries": ([list(e) for e in slot["best_entries"]]
+                                  if slot["best_entries"] is not None
+                                  else None)}
+                for slot in self.state]
+
+    def set_state(self, state: List[dict]) -> None:
+        """Resume-path inverse of get_state; marks the callback started so
+        ``_start`` does not re-append fresh slots."""
+        self.enabled = True
+        self.state = []
+        for slot in state:
+            bigger_better = bool(slot["bigger_better"])
+            self.state.append({
+                "best": float(slot["best"]),
+                "better": (lambda a, b: a > b) if bigger_better
+                          else (lambda a, b: a < b),
+                "bigger_better": bigger_better,
+                "best_iter": int(slot["best_iter"]),
+                "best_entries": ([tuple(e) for e in slot["best_entries"]]
+                                 if slot["best_entries"] is not None
+                                 else None),
             })
 
     def _finish(self, slot: dict, reason: str) -> None:
@@ -211,3 +244,15 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     """Stop when no validation metric improved for ``stopping_rounds``
     consecutive iterations; records the best iteration on the exception."""
     return _EarlyStopping(stopping_rounds, first_metric_only, verbose)
+
+
+def checkpoint(directory: str, period: int = 1, keep_last_n: int = 3,
+               on_sigterm: bool = True) -> Callable:
+    """Preemption-safe training snapshots (lightgbm_tpu.checkpoint): save
+    the complete training state into ``directory`` every ``period``
+    iterations and on SIGTERM; resume with
+    ``engine.train(..., resume_from=directory)``. See docs/Checkpointing.md.
+    """
+    from .checkpoint.callback import _Checkpoint
+    return _Checkpoint(directory, period=period, keep_last_n=keep_last_n,
+                       on_sigterm=on_sigterm)

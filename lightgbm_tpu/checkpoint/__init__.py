@@ -1,0 +1,23 @@
+"""lightgbm_tpu.checkpoint — preemption-safe training checkpoints.
+
+A snapshot captures the COMPLETE training state — trees as raw arrays, the
+f32 score matrix, every RNG cursor (bagging/GOSS ``PRNGKey``,
+feature-fraction and DART ``RandomState``, DART tree weights), eval
+history and early-stopping slots — under a checksummed, atomically-written
+manifest with retention. A run killed at iteration *k* and resumed with
+``engine.train(..., resume_from=dir)`` produces a model file byte-identical
+to the uninterrupted run; corrupt/truncated snapshots are detected and
+skipped in favor of the previous valid one. See docs/Checkpointing.md.
+"""
+from .callback import checkpoint
+from .manager import CheckpointManager, SnapshotHandle
+from .manifest import Manifest
+from .resume import load_latest, restore
+from .snapshot import (check_compatibility, config_hash,
+                       dataset_fingerprint)
+
+__all__ = [
+    "checkpoint", "CheckpointManager", "SnapshotHandle", "Manifest",
+    "load_latest", "restore", "check_compatibility", "config_hash",
+    "dataset_fingerprint",
+]

@@ -119,6 +119,13 @@ def run_train(config: Config, params: Dict) -> None:
                 env.model.save_model("%s.snapshot_iter_%d" % (out, it))
         snapshot_cb.order = 40
         callbacks.append(snapshot_cb)
+    if config.checkpoint_dir:
+        # preemption-safe full-state snapshots + SIGTERM handling
+        # (lightgbm_tpu.checkpoint; resume with resume=<dir>)
+        from .callback import checkpoint as checkpoint_cb
+        callbacks.append(checkpoint_cb(config.checkpoint_dir,
+                                       period=config.checkpoint_period,
+                                       keep_last_n=config.checkpoint_keep))
 
     booster = engine.train(
         dict(params), train_set,
@@ -129,7 +136,8 @@ def run_train(config: Config, params: Dict) -> None:
         early_stopping_rounds=(config.early_stopping_round
                                if config.early_stopping_round > 0 else None),
         verbose_eval=False,
-        callbacks=callbacks or None)
+        callbacks=callbacks or None,
+        resume_from=(config.resume or None))
     booster.save_model(config.output_model)
     Log.info("Finished training; model saved to %s", config.output_model)
 

@@ -90,6 +90,12 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("data_random_seed", int, 1, ["data_seed"]),
     ("output_model", str, "LightGBM_model.txt", ["model_output", "model_out"]),
     ("snapshot_freq", int, -1, ["save_period"]),
+    # preemption-safe checkpoints (lightgbm_tpu.checkpoint,
+    # docs/Checkpointing.md): full-training-state snapshots + exact resume
+    ("checkpoint_dir", str, "", ["checkpoint_directory", "checkpoint_path"]),
+    ("checkpoint_period", int, 1, ["checkpoint_freq"]),
+    ("checkpoint_keep", int, 3, ["checkpoint_keep_last_n"]),
+    ("resume", str, "", ["resume_from", "resume_dir"]),
     ("input_model", str, "", ["model_input", "model_in"]),
     ("output_result", str, "LightGBM_predict_result.txt",
      ["predict_result", "prediction_result", "predict_name", "prediction_name",
@@ -406,6 +412,12 @@ class Config:
         if self.tpu_row_chunk < 0:
             raise LightGBMError("tpu_row_chunk should be >= 0 (0 = auto), "
                                 "got %s" % self.tpu_row_chunk)
+        if self.checkpoint_period < 1:
+            raise LightGBMError("checkpoint_period should be >= 1, got %s"
+                                % self.checkpoint_period)
+        if self.checkpoint_keep < 1:
+            raise LightGBMError("checkpoint_keep should be >= 1, got %s"
+                                % self.checkpoint_keep)
         if self.verbosity >= 0:
             Log.reset_level(self.verbosity)
 

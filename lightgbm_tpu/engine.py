@@ -33,8 +33,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
           verbose_eval: Union[bool, int] = True,
           learning_rates: Optional[Union[List[float], Callable]] = None,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """engine.py:19 — train with the reference's full signature."""
+          callbacks: Optional[List[Callable]] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """engine.py:19 — train with the reference's full signature, plus
+    ``resume_from``: a lightgbm_tpu.checkpoint directory to continue from
+    (``num_boost_round`` stays the TOTAL target — a run checkpointed at
+    iteration k trains the remaining ``num_boost_round - k`` rounds and
+    produces a model byte-identical to the uninterrupted run;
+    docs/Checkpointing.md)."""
     params = copy.deepcopy(params) if params else {}
     # resolve num_boost_round aliases out of params (engine.py:96-107)
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
@@ -96,16 +102,46 @@ def train(params: Dict[str, Any], train_set: Dataset,
         cbs.append(callback.record_evaluation(evals_result))
     if learning_rates is not None:
         cbs.append(callback.reset_parameter(learning_rate=learning_rates))
+    # checkpoint_dir in params auto-attaches the checkpoint callback (the
+    # CLI's config-driven path; Python users can pass callback.checkpoint
+    # explicitly instead)
+    if booster.config.checkpoint_dir and \
+            not any(getattr(c, "is_checkpoint", False) for c in cbs):
+        cbs.append(callback.checkpoint(
+            booster.config.checkpoint_dir,
+            period=booster.config.checkpoint_period,
+            keep_last_n=booster.config.checkpoint_keep))
     cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
     cbs_after = [c for c in cbs if not getattr(c, "before_iteration", False)]
     cbs_before.sort(key=lambda c: getattr(c, "order", 0))
     cbs_after.sort(key=lambda c: getattr(c, "order", 0))
+    # the checkpoint callback reads loop-level state (early stopping) off
+    # the booster when it snapshots
+    booster._callbacks = cbs_before + cbs_after
+
+    # resume (lightgbm_tpu.checkpoint): restore driver + callback state,
+    # shrink the remaining-round budget to the original total
+    resumed = False
+    if resume_from is None and booster.config.resume:
+        resume_from = booster.config.resume
+    if resume_from:
+        from . import checkpoint as ckpt_mod
+        handle = ckpt_mod.load_latest(resume_from)
+        if handle is None:
+            Log.info("resume_from=%s: no checkpoint found; starting fresh",
+                     resume_from)
+        else:
+            completed = ckpt_mod.restore(booster, handle,
+                                         cbs_before + cbs_after)
+            num_boost_round = max(num_boost_round - completed, 0)
+            resumed = True
 
     # boosting loop (engine.py:211-246)
     init_iteration = booster.current_iteration
     finished_early = False
     evaluation_result_list = []
     if valid_sets is None and fobj is None and not cbs_before and \
+            not resumed and \
             all(getattr(c, "only_consumes_evals", False) for c in cbs_after):
         # nothing needs the host between iterations (eval-display callbacks
         # are no-ops with no valid sets): fuse the whole loop into

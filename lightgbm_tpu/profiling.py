@@ -155,7 +155,41 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
         # find_split works on per-feature views; without EFB hist == view
         if not params.with_efb:
             out["find_split"] = _timed(split_fn, hist)
+
+        # checkpoint overhead (lightgbm_tpu.checkpoint): one full-state
+        # snapshot save + restore on the booster's real model/shapes, so
+        # the per-period cost shows up next to the phases it competes with
+        out.update(_checkpoint_probe(booster))
     finally:
         if trace_dir:
             jax.profiler.stop_trace()
     return {k: round(v, 5) for k, v in out.items()}
+
+
+def _checkpoint_probe(booster) -> Dict[str, float]:
+    """checkpoint_save_s / checkpoint_restore_s: wall time of one snapshot
+    write (state npz + manifest + model text) and one verified load back
+    into the same driver. Restoring the state it just saved is a no-op for
+    the booster. Empty dict when the booster has no trained trees yet."""
+    import shutil
+    import tempfile
+    try:
+        if not booster.models:
+            return {}
+        from .checkpoint.manager import CheckpointManager
+        tmp = tempfile.mkdtemp(prefix="lgbm_tpu_ckpt_probe_")
+        try:
+            mgr = CheckpointManager(tmp, keep_last_n=1)
+            t0 = time.perf_counter()
+            mgr.save(booster)
+            save_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            handle = mgr.load_latest()
+            booster.load_training_state(handle.meta, handle.arrays)
+            restore_s = time.perf_counter() - t0
+            return {"checkpoint_save_s": save_s,
+                    "checkpoint_restore_s": restore_s}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception:  # noqa: BLE001 - a probe must not kill the caller
+        return {}

@@ -121,11 +121,23 @@ class ServingEngine:
         self.mesh = serving_mesh(num_devices) if num_devices != 1 else None
         self._cache: Dict[Tuple, _CompiledPredictor] = {}
         self._lock = threading.Lock()
+        # atomic re-registration (checkpoint hot-roll): purge this model's
+        # compiled predictors when its bundle is swapped
+        self.registry.add_replace_listener(self._invalidate_model)
 
     # ------------------------------------------------------------ cache
+    def _invalidate_model(self, model_id: str) -> None:
+        """Drop every cache entry compiled against a replaced bundle. The
+        generation in the cache key already prevents stale *hits*; this
+        reclaims the dead entries' device memory."""
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == model_id]:
+                del self._cache[key]
+
     def _predictor(self, bundle: ModelBundle, bucket: int, raw_score: bool,
                    iters: int) -> _CompiledPredictor:
-        key = (bundle.model_id, bucket, bool(raw_score), iters)
+        key = (bundle.model_id, getattr(bundle, "generation", 0), bucket,
+               bool(raw_score), iters)
         with self._lock:
             entry = self._cache.get(key)
             if entry is None:

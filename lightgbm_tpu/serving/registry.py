@@ -45,6 +45,7 @@ class ModelBundle:
         self.feature_names = list(feature_names or [])
         self.pandas_categorical = pandas_categorical
         self.total_iterations = int(trees.leaf_value.shape[0])
+        self.generation = 0       # bumped by ModelRegistry.register
         self._capped: Dict[int, "jnp.ndarray"] = {}
         self._lock = threading.Lock()
 
@@ -98,33 +99,68 @@ class ModelBundle:
 
 
 class ModelRegistry:
-    """Named, immutable model bundles (the serving fleet's model store)."""
+    """Named, immutable model bundles (the serving fleet's model store).
+
+    Bundles never mutate; re-registration with ``replace=True`` swaps the
+    whole bundle atomically under the registry lock and bumps that model's
+    generation counter. Replace listeners (ServingEngine's predictor-cache
+    purge) fire after the swap, outside the lock.
+    """
 
     def __init__(self):
         self._bundles: Dict[str, ModelBundle] = {}
+        self._generation: Dict[str, int] = {}
+        self._replace_listeners: List = []
         self._lock = threading.Lock()
 
-    def load_file(self, model_id: str, path: str) -> ModelBundle:
+    def load_file(self, model_id: str, path: str,
+                  replace: bool = False) -> ModelBundle:
         """Load a LightGBM model-text file (io/model_text.py format)."""
         from ..basic import Booster
         from ..io.model_text import parse_model_file
         parse_model_file(path)   # fail fast with a format error, not mid-serve
         booster = Booster(model_file=path)
-        return self.register_booster(model_id, booster)
+        return self.register_booster(model_id, booster, replace=replace)
 
-    def register_booster(self, model_id: str, booster) -> ModelBundle:
-        return self.register(ModelBundle.from_booster(model_id, booster))
+    def register_booster(self, model_id: str, booster,
+                         replace: bool = False) -> ModelBundle:
+        return self.register(ModelBundle.from_booster(model_id, booster),
+                             replace=replace)
 
-    def register_impl(self, model_id: str, impl) -> ModelBundle:
-        return self.register(ModelBundle.from_impl(model_id, impl))
+    def register_impl(self, model_id: str, impl,
+                      replace: bool = False) -> ModelBundle:
+        return self.register(ModelBundle.from_impl(model_id, impl),
+                             replace=replace)
 
-    def register(self, bundle: ModelBundle) -> ModelBundle:
+    def register(self, bundle: ModelBundle,
+                 replace: bool = False) -> ModelBundle:
+        replaced = False
         with self._lock:
-            if bundle.model_id in self._bundles:
-                raise LightGBMError("model id %r already registered"
+            if bundle.model_id in self._bundles and not replace:
+                raise LightGBMError("model id %r already registered "
+                                    "(pass replace=True to swap it)"
                                     % bundle.model_id)
+            replaced = bundle.model_id in self._bundles
+            gen = self._generation.get(bundle.model_id, 0) + 1
+            self._generation[bundle.model_id] = gen
+            bundle.generation = gen
             self._bundles[bundle.model_id] = bundle
+            listeners = list(self._replace_listeners)
+        if replaced:
+            # outside the lock: listeners may take their own locks
+            # (ServingEngine purges its compiled-predictor cache here)
+            for fn in listeners:
+                fn(bundle.model_id)
         return bundle
+
+    def generation(self, model_id: str) -> int:
+        with self._lock:
+            return self._generation.get(model_id, 0)
+
+    def add_replace_listener(self, fn) -> None:
+        """``fn(model_id)`` is called after an existing model is replaced."""
+        with self._lock:
+            self._replace_listeners.append(fn)
 
     def get(self, model_id: str) -> ModelBundle:
         with self._lock:
@@ -137,3 +173,75 @@ class ModelRegistry:
     def ids(self) -> List[str]:
         with self._lock:
             return sorted(self._bundles)
+
+    # ------------------------------------------------- checkpoint hot-roll
+    def watch_dir(self, model_id: str, checkpoint_dir: str,
+                  poll_interval: float = 10.0,
+                  start: bool = False) -> "CheckpointWatcher":
+        """Hot-roll the newest valid snapshot of a lightgbm_tpu.checkpoint
+        directory into this registry under ``model_id``. Returns a watcher;
+        call ``poll()`` for one synchronous check (the first poll registers
+        the current snapshot) or pass ``start=True`` for a daemon-thread
+        loop. Replacement is atomic and invalidates the model's compiled
+        predictors via the replace listeners."""
+        w = CheckpointWatcher(self, model_id, checkpoint_dir, poll_interval)
+        if start:
+            w.start()
+        return w
+
+
+class CheckpointWatcher:
+    """Polls a checkpoint directory's manifest; loads newer snapshots."""
+
+    def __init__(self, registry: ModelRegistry, model_id: str,
+                 checkpoint_dir: str, poll_interval: float = 10.0):
+        self.registry = registry
+        self.model_id = model_id
+        self.checkpoint_dir = checkpoint_dir
+        self.poll_interval = float(poll_interval)
+        self._last_id = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll(self) -> bool:
+        """One check: register the newest valid snapshot if it is newer
+        than what we already rolled in. Returns True when a (re)load
+        happened; verification failures fall back exactly like resume
+        does (manifest checksums, newest -> oldest)."""
+        from ..checkpoint.manager import CheckpointManager
+        from ..log import Log
+        latest = CheckpointManager(self.checkpoint_dir).latest_model()
+        if latest is None:
+            return False
+        snap_id, model_path = latest
+        if snap_id <= self._last_id:
+            return False
+        self.registry.load_file(self.model_id, model_path, replace=True)
+        self._last_id = snap_id
+        Log.info("serving: hot-rolled snapshot %d from %s into model %r",
+                 snap_id, self.checkpoint_dir, self.model_id)
+        return True
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.wait(self.poll_interval):
+                try:
+                    self.poll()
+                except Exception as e:  # noqa: BLE001 - keep serving alive
+                    from ..log import Log
+                    Log.warning("checkpoint watcher %r: %s",
+                                self.model_id, e)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="ckpt-watch-%s" % self.model_id)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
