@@ -189,11 +189,22 @@ def run_bench(backend_info: dict) -> dict:
     # sweep hook: BENCH_HIST_IMPL in {auto, matmul, scatter, pallas}
     if os.environ.get("BENCH_HIST_IMPL"):
         cfg_d["tpu_hist_impl"] = os.environ["BENCH_HIST_IMPL"]
+    # persistent XLA compile cache (compile_cache_dir): warm runs skip
+    # backend compilation; compile_and_warmup then measures reload time
+    if os.environ.get("BENCH_COMPILE_CACHE"):
+        cfg_d["compile_cache_dir"] = os.environ["BENCH_COMPILE_CACHE"]
     # free-form sweep hook: BENCH_EXTRA_PARAMS="k=v k2=v2"
     for tok in os.environ.get("BENCH_EXTRA_PARAMS", "").split():
         if "=" in tok:
             k, v = tok.split("=", 1)
             cfg_d[k] = v
+    # compile accounting from the first compile on: the timed windows
+    # below must report ZERO backend compiles after the warmup block —
+    # the training-side analog of serving's recompile invariant
+    from lightgbm_tpu.profiling import (backend_compile_count,
+                                        compile_cache_stats,
+                                        install_compile_hook)
+    install_compile_hook()
     cfg = Config(cfg_d)
     ds = BinnedDataset.from_matrix(X, cfg, label=y)
     b = create_boosting(cfg, ds, create_objective(cfg), [])
@@ -201,10 +212,12 @@ def run_bench(backend_info: dict) -> dict:
 
     t_c0 = time.time()
     # warm with the SAME block size so the timed section reuses the
-    # compiled fused block
+    # compiled fused block (train_many also pre-warms the frontier
+    # wave-width bucket ladder on its first call)
     b.train_many(iters)
     jax.block_until_ready(b.scores)
     t_compile_warmup = time.time() - t_c0
+    compile_floor = backend_compile_count()
 
     # fused on-device blocks (lax.scan over iterations) — the measured
     # path is the real training path engine.train uses with no callbacks.
@@ -218,6 +231,11 @@ def run_bench(backend_info: dict) -> dict:
         jax.block_until_ready(b.scores)
         windows.append(time.time() - t0)
     dt = min(windows)
+    # the measured invariant: both timed windows (every tree, every
+    # iteration, all wave-width buckets) reuse warmup's executables
+    train_recompiles = backend_compile_count() - compile_floor
+    ladder_info = getattr(b, "_ladder_warmup", None) or {}
+    cache_stats = compile_cache_stats()
 
     iters_per_sec = iters / dt
     higgs_equiv = iters_per_sec * (n / HIGGS_ROWS)
@@ -328,6 +346,16 @@ def run_bench(backend_info: dict) -> dict:
                      "throughput zeroed" % auc}),
         "raw_iters_per_sec": round(iters_per_sec, 4),
         "rows_features_per_sec_per_chip": round(iters_per_sec * n * f, 1),
+        "train_recompiles_after_warmup": int(train_recompiles),
+        "compile_cache_hits": int(cache_stats["persistent_cache_hits"]),
+        "compile_cache_misses": int(cache_stats["persistent_cache_misses"]),
+        **({"frontier_wave_ladder": list(ladder_info["widths"]),
+            "frontier_ladder_compiles": {
+                str(w): c for w, c in
+                ladder_info.get("per_bucket_compiles", {}).items()},
+            "frontier_ladder_warmup_s":
+                round(float(ladder_info.get("seconds", 0.0)), 3)}
+           if ladder_info.get("widths") else {}),
         **serve,
         "phase_seconds": {"binning": round(t_bin, 3),
                           "compile_and_warmup": round(t_compile_warmup, 3),

@@ -23,6 +23,7 @@ Key mappings:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -212,6 +213,12 @@ class GBDT:
                  objective: Optional[ObjectiveFunction],
                  metrics: Optional[List[Metric]] = None):
         self.config = config
+        if getattr(config, "compile_cache_dir", ""):
+            # persistent XLA compile cache: wired before the first jit so
+            # every executable this booster builds is cacheable — warm
+            # starts (same shapes, same jax) then compile nothing
+            from ..profiling import enable_compile_cache
+            enable_compile_cache(config.compile_cache_dir)
         if _hist_dtype(config) == "f64" and not jax.config.jax_enable_x64:
             # reference gpu_use_dp = double-precision histograms
             # (config.h:784); jax needs x64 enabled for f64 to exist at
@@ -466,6 +473,11 @@ class GBDT:
             batched_pack=(batch_splits > 0 and cfg.tpu_batched_pack),
             batched_part=batched_part,
             frontier_mode=frontier_mode,
+            # wave-width bucketing: off under vmapped multiclass growth —
+            # vmap lowers the width switch to execute-ALL-branches, which
+            # costs ~2x the fixed-width wave instead of saving it
+            frontier_bucketing=(frontier_mode and not vmapped
+                                and bool(cfg.tpu_frontier_bucketing)),
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
@@ -503,6 +515,7 @@ class GBDT:
         self._compiled_iter = None
         self._iter_core = None
         self._compiled_block = None
+        self._ladder_warmup: Optional[Dict[str, Any]] = None
         self._valid_pred_cache: Dict[int, jnp.ndarray] = {}
 
     def add_valid_data(self, ds: BinnedDataset, metrics: List[Metric]) -> None:
@@ -1029,7 +1042,6 @@ class GBDT:
         frac = cfg.bagging_fraction
         row_valid = self._row_valid
 
-        @jax.jit
         def run_block(xb, obj_rows, fp_capture, scores, feature_masks,
                       goss_actives, iter_idxs, keys, bag_mask0, cegb_state,
                       stopped_in, lr):
@@ -1058,7 +1070,76 @@ class GBDT:
             new_scores, bag_mask, cegb_out, stopped_out = carry
             return packs, new_scores, bag_mask, cegb_out, stopped_out
 
-        return run_block
+        # donate the block's threaded train-state buffers (scores [N, K]
+        # and the bagging mask [N]) — both are rebound to the block's
+        # outputs by the caller, so XLA may alias the output into the
+        # input allocation instead of holding both live. CPU has no
+        # donation support and would warn per compile, so gate on backend.
+        donate = ((3, 8) if cfg.tpu_donate_buffers
+                  and jax.default_backend() != "cpu" else ())
+        return jax.jit(run_block, donate_argnums=donate)
+
+    def warmup_wave_ladder(self) -> Dict[str, Any]:
+        """Pre-compile ``build_histogram_frontier`` at every wave-width
+        bucket the frontier grower can dispatch (the serving ``warmup()``
+        analog for training): one all-inactive-slot call per ladder width
+        on the real data shapes, so standalone probes and eager frontier
+        calls after this never compile — and with ``compile_cache_dir``
+        set, later PROCESSES reload every specialization from disk.
+        Returns per-bucket compile counts + seconds (reported by
+        profiling/bench). No-op unless the booster grows frontier-mode.
+        """
+        from .. import bucketing
+        from ..profiling import backend_compile_count, compile_cache_stats
+        params = self.grow_params
+        if not getattr(params, "frontier_mode", False) or \
+                self.mesh is not None:
+            # mesh growth compiles inside shard_map on shard-local shapes;
+            # the standalone global-shape warmup would not match it
+            return {"widths": [], "per_bucket_compiles": {},
+                    "seconds": 0.0, "cache_hits": 0, "cache_misses": 0}
+        from ..core.histogram import build_histogram_frontier
+        widths = (bucketing.wave_width_ladder(params.num_leaves,
+                                              params.max_depth)
+                  if params.frontier_bucketing
+                  else [bucketing.frontier_max_width(params.num_leaves,
+                                                     params.max_depth)])
+        n = self.num_data
+        slot = jnp.full((n,), -1, jnp.int32)     # all-inactive: cheap sweep
+        g = jnp.zeros((n,), jnp.float32)
+        h = jnp.ones((n,), jnp.float32)
+        mask = jnp.ones((n,), jnp.float32)
+        before = compile_cache_stats()
+        t0 = time.perf_counter()
+        per_bucket: Dict[int, int] = {}
+        for w in widths:
+            c0 = backend_compile_count()
+            jax.block_until_ready(build_histogram_frontier(
+                self.xb, slot, g, h, mask, num_bins=params.num_bins,
+                num_slots=w, row_chunk=params.row_chunk,
+                impl=params.hist_impl))
+            per_bucket[w] = backend_compile_count() - c0
+        after = compile_cache_stats()
+        return {
+            "widths": widths,
+            "per_bucket_compiles": per_bucket,
+            "seconds": time.perf_counter() - t0,
+            "cache_hits": (after["persistent_cache_hits"]
+                           - before["persistent_cache_hits"]),
+            "cache_misses": (after["persistent_cache_misses"]
+                             - before["persistent_cache_misses"]),
+        }
+
+    def _maybe_warm_ladder(self) -> None:
+        """Run the bucket-ladder warmup once, at train start — only when a
+        persistent compile cache is configured. In-process, every switch
+        branch compiles INSIDE the first training block's program anyway;
+        the eager ladder exists to populate the cross-process cache and to
+        produce the per-bucket compile/hit/miss accounting, both of which
+        only matter in compile_cache_dir runs (bench, the CI smoke)."""
+        if self._ladder_warmup is None and \
+                getattr(self.config, "compile_cache_dir", ""):
+            self._ladder_warmup = self.warmup_wave_ladder()
 
     def train_many(self, num_iters: int) -> bool:
         """Run ``num_iters`` iterations, fusing them into on-device blocks
@@ -1077,6 +1158,7 @@ class GBDT:
             return False
 
         self._boost_from_average()
+        self._maybe_warm_ladder()
         if self._iter_core is None:
             self._compiled_iter = self._make_train_iter_fn()
         if self._compiled_block is None:
@@ -1092,7 +1174,11 @@ class GBDT:
             gactive = jnp.asarray(
                 [self._goss_active(self.iter_ + i) for i in range(block)],
                 jnp.float32)
-            idxs = jnp.arange(self.iter_, self.iter_ + block, dtype=jnp.int32)
+            # host-side arange: jnp.arange with a nonzero start compiles a
+            # tiny convert_element_type on the SECOND block (start=0 takes
+            # the iota path), breaking zero-recompiles-after-warmup
+            idxs = jnp.asarray(np.arange(self.iter_, self.iter_ + block,
+                                         dtype=np.int32))
             all_keys = jax.random.split(self._bag_key, block + 1)
             self._bag_key = all_keys[0]
             packs, self.scores, self._bag_mask, self._cegb_state, \
@@ -1255,6 +1341,7 @@ class GBDT:
         if self._stopped:
             return True
         self._boost_from_average()
+        self._maybe_warm_ladder()
         if self._compiled_iter is None:
             self._compiled_iter = self._make_train_iter_fn()
 

@@ -183,6 +183,19 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # of O(num_leaves) (core/grow_frontier.py).
     ("tree_growth", str, "exact", ["growth_mode", "tree_grow_mode"]),
     ("tree_batch_splits", int, 16, []),
+    # frontier wave-width bucketing (core/grow_frontier.py): specialize
+    # each wave at the smallest pow-2 slot count covering the live
+    # frontier instead of always num_leaves - 1 — hist FLOPs and psum
+    # payload track 2^depth on early waves, structure unchanged. false
+    # pins every wave at the fixed maximum width (debug / A-B runs).
+    ("tpu_frontier_bucketing", bool, True, ["frontier_bucketing"]),
+    # persistent XLA compilation cache (jax_compilation_cache_dir):
+    # compiled executables are written here and reloaded by later
+    # processes, so warm starts skip backend compilation entirely —
+    # profiling.enable_compile_cache wires it before the first compile
+    # and counts hits/misses. Empty = off (jax default).
+    ("compile_cache_dir", str, "", ["compilation_cache_dir",
+                                    "jax_compilation_cache_dir"]),
     # batched growth: pack active rows so dead row tiles skip the slot
     # kernel's compute (cost ~ split-leaf rows, not N); opt-in until
     # measured on chip
@@ -412,6 +425,15 @@ class Config:
         if self.tpu_row_chunk < 0:
             raise LightGBMError("tpu_row_chunk should be >= 0 (0 = auto), "
                                 "got %s" % self.tpu_row_chunk)
+        # a file where the cache DIRECTORY should be will corrupt silently
+        # deep inside jax; fail at config time like the other path params
+        if self.compile_cache_dir:
+            import os
+            if os.path.exists(self.compile_cache_dir) and \
+                    not os.path.isdir(self.compile_cache_dir):
+                raise LightGBMError(
+                    "compile_cache_dir %s exists and is not a directory"
+                    % self.compile_cache_dir)
         if self.checkpoint_period < 1:
             raise LightGBMError("checkpoint_period should be >= 1, got %s"
                                 % self.checkpoint_period)

@@ -131,6 +131,15 @@ class GrowParams(NamedTuple):
     # instead of O(num_leaves). Split selection stays leaf-wise/best-first
     # within each wave (gain-ranked node numbering, like batched growth)
     frontier_mode: bool = False
+    # wave-width bucketing (tpu_frontier_bucketing): the frontier
+    # while_loop body lax.switches into a wave step specialized at the
+    # smallest pow-2 ladder width covering the live positive-gain
+    # frontier, so early waves pay 2^w slot-sweeps instead of
+    # num_leaves - 1 (lightgbm_tpu.bucketing.wave_width_ladder). Committed
+    # splits and numbering are identical to the fixed-width wave. Must
+    # stay off under vmapped_classes — vmap lowers switch to
+    # execute-all-branches, which would cost MORE than fixed width.
+    frontier_bucketing: bool = False
 
 
 class TreeArrays(NamedTuple):

@@ -24,10 +24,30 @@ node-indexed pass over the data. This module is that schedule:
 Routing differs from grow_batched.route_split_rows on purpose: that
 helper materializes a [K, N] one-hot so per-STEP routing costs no
 per-row gathers — the right trade at K<=32 where the one-hot is cheap
-and steps are many. Here K = num_leaves - 1 (every leaf can split), so a
-[K, N] one-hot would be O(L*N) per wave; instead each row gathers its
-own split's parameters (~6 per-row gathers per WAVE), which runs
-O(depth) times per tree, not O(num_leaves) times.
+and steps are many. Here K can be num_leaves - 1 (every leaf can
+split), so a [K, N] one-hot would be O(L*N) per wave; instead each row
+gathers its own split's parameters (~6 per-row gathers per WAVE), which
+runs O(depth) times per tree, not O(num_leaves) times.
+
+Wave-width bucketing (GrowParams.frontier_bucketing): wave ``w`` has at
+most ``min(2^w, leaf budget)`` positive-gain leaves, but a fixed-width
+wave builds the full ``[kb, C, B, 3]`` histogram tensor regardless —
+~``depth * kb`` slot-sweeps per tree where ~``num_leaves`` are live.
+Both GPU GBDT papers size the node dimension to the actual frontier;
+here that is done with compile-time specialization, reusing serving's
+pow-2 bucket ladder (lightgbm_tpu.bucketing): the while_loop body counts
+the live frontier and ``lax.switch``es into a wave step specialized at
+the smallest ladder width covering it, so hist FLOPs and the per-wave
+psum payload track ``2^w`` on early waves. Occupancy-weighted
+slot-sweeps become ``sum_w bucket(live_w) <= 2 * (num_leaves - 1)``.
+Every branch runs the same gain-ranked top_k prefix (stable ties, and
+the live set always fits the chosen width), so committed splits, node
+numbering, and the hist pool are bit-identical to the fixed-width path.
+The branch index derives from psum-replicated gains, so all devices of
+a shard_map mesh take the same branch and the per-branch psum is a
+uniform collective. The ladder is also clamped by max_depth — a
+depth-``d`` tree's frontier never exceeds ``2^(d-1)`` leaves (depth-
+capped children are never granted positive gain).
 
 Semantics: splitting every positive-gain frontier leaf is exactly the
 set of splits exact best-first performs when the num_leaves cap never
@@ -47,6 +67,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..bucketing import frontier_max_width, wave_width_ladder
 from ..compat import pcast
 from .histogram import build_histogram, build_histogram_frontier
 from .grow import (GrowParams, TreeArrays, _bin_go_left, _empty_best,
@@ -110,7 +131,10 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
     l = params.num_leaves
     b = params.num_bins
     sp = params.split
-    kb = l - 1                     # wave width: any frontier leaf can split
+    # max wave width: any frontier leaf can split, but max_depth bounds
+    # the frontier at 2^(d-1) leaves — without the clamp a shallow-tree
+    # config pays full num_leaves-1 slot-sweeps per wave
+    kb = frontier_max_width(l, params.max_depth)
     with_efb = params.with_efb
 
     def psum(x):
@@ -157,18 +181,22 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
     def cond_fn(s: _FrontierState) -> jnp.ndarray:
         return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
 
-    def step(s: _FrontierState) -> _FrontierState:
+    def wave_step(s: _FrontierState, kw: int) -> _FrontierState:
+        """One frontier wave at static width ``kw`` (1 <= kw <= kb). The
+        caller guarantees the live positive-gain frontier fits in ``kw``
+        lanes, so the top_k prefix it commits — and therefore the grown
+        structure and numbering — is identical for every width."""
         tree = s.tree
         nl = tree.num_leaves                      # dynamic scalar
-        rank = jnp.arange(kb, dtype=jnp.int32)
-        gval, gleaf = lax.top_k(s.best.gain, kb)  # distinct leaves, desc
+        rank = jnp.arange(kw, dtype=jnp.int32)
+        gval, gleaf = lax.top_k(s.best.gain, kw)  # distinct leaves, desc
         # the whole positive-gain frontier splits, gain-ranked; both
         # conditions are prefix masks of the sorted ranks
         valid = (gval > 0.0) & (rank < (l - nl))
         nvalid = jnp.sum(valid.astype(jnp.int32))
-        node = (nl - 1) + rank                    # [kb]
-        right_leaf = nl + rank                    # [kb]
-        cur = jax.tree.map(lambda a: a[gleaf], s.best)   # fields [kb]
+        node = (nl - 1) + rank                    # [kw]
+        right_leaf = nl + rank                    # [kw]
+        cur = jax.tree.map(lambda a: a[gleaf], s.best)   # fields [kw]
 
         # ---- route every row through its leaf's split -------------------
         rank_of_leaf = jnp.full((l,), -1, jnp.int32)
@@ -185,13 +213,13 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         # leaf's split, else -1 (inactive); the larger sibling is derived
         # from the pool by subtraction, so the sweep touches each
         # splitting row at most once and the wave costs one pass total
-        left_small = cur.left_count <= cur.right_count       # [kb]
+        left_small = cur.left_count <= cur.right_count       # [kw]
         in_small = active & (go_left == left_small[rs])
         slot = jnp.where(in_small, rs, -1)
         hist_small = psum(build_histogram_frontier(
-            xb, slot, grad, hess, sample_mask, num_bins=b, num_slots=kb,
+            xb, slot, grad, hess, sample_mask, num_bins=b, num_slots=kw,
             row_chunk=params.row_chunk,
-            impl=params.hist_impl))                # [kb, C, B, 3]
+            impl=params.hist_impl))                # [kw, C, B, 3]
 
         parent_hist = s.hist_pool[jnp.where(valid, gleaf, 0)]
         hist_large = parent_hist - hist_small
@@ -215,7 +243,7 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
 
         # ---- best splits for all 2K children, one vmapped search --------
         ch_hist = jnp.stack([hist_left, hist_right],
-                            axis=1).reshape(2 * kb, ncols, b, 3)
+                            axis=1).reshape(2 * kw, ncols, b, 3)
         ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
         ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
         ch_cnt = interleave_lr(cur.left_count, cur.right_count)
@@ -227,6 +255,28 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         return _FrontierState(leaf_id=leaf_id, hist_pool=pool, best=best,
                               tree=tree, leaf_min=leaf_min,
                               leaf_max=leaf_max)
+
+    ladder = wave_width_ladder(l, params.max_depth)  # pow-2 widths, <= kb
+    if params.frontier_bucketing and len(ladder) > 1:
+        # adaptive width: count the live frontier and dispatch the wave
+        # step specialized at the smallest covering ladder width. ``live``
+        # is replicated across a shard_map mesh (gains derive from psum'd
+        # histograms), so every device takes the same branch and the
+        # branch-local psum stays a uniform collective. cond_fn guarantees
+        # live >= 1; live <= kb always (the frontier is one depth level,
+        # bounded by 2^(max_depth-1) and by the nl < l leaf budget), so
+        # the chosen width never truncates the live set.
+        widths = jnp.asarray(ladder, jnp.int32)
+        branches = [lambda s, w=w: wave_step(s, w) for w in ladder]
+
+        def step(s: _FrontierState) -> _FrontierState:
+            live = jnp.sum(s.best.gain > 0.0)
+            return lax.switch(jnp.sum(live > widths), branches, s)
+    else:
+        # fixed width (frontier_bucketing=false, or a degenerate ladder):
+        # every wave runs at the clamped maximum
+        def step(s: _FrontierState) -> _FrontierState:
+            return wave_step(s, kb)
 
     state = lax.while_loop(cond_fn, step, state)
     return state.tree, state.leaf_id, None

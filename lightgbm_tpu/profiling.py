@@ -8,12 +8,94 @@ booster's real shapes and timed. The taxonomy mirrors the reference's
 """
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# ---------------------------------------------------------------- compiles
+# Process-wide compile accounting, shared by serving.metrics and the
+# training-side zero-recompile invariant (bench.py, compile_cache_smoke):
+#
+# - ``backend_compiles`` rides jax.monitoring's backend-compile duration
+#   event, so it counts REAL XLA compilations — including accidental
+#   retraces a cache key cannot see (shape leaks, weak-type flips);
+# - ``persistent_cache_hits``/``misses`` ride the compilation-cache events,
+#   so a warm ``compile_cache_dir`` shows up as hits. (The backend-compile
+#   duration event fires on cache hits too in this jax, so hits/misses —
+#   not the backend count — are what distinguish a warm start.)
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_counts_lock = threading.Lock()
+_counts = {"backend_compiles": 0, "persistent_cache_hits": 0,
+           "persistent_cache_misses": 0}
+_hooks_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    if event == _BACKEND_COMPILE_EVENT:
+        with _counts_lock:
+            _counts["backend_compiles"] += 1
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _CACHE_HIT_EVENT:
+        with _counts_lock:
+            _counts["persistent_cache_hits"] += 1
+    elif event == _CACHE_MISS_EVENT:
+        with _counts_lock:
+            _counts["persistent_cache_misses"] += 1
+
+
+def install_compile_hook() -> None:
+    """Register the compile/cache listeners (idempotent, process-wide)."""
+    global _hooks_installed
+    with _counts_lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    jax.monitoring.register_event_listener(_on_event)
+
+
+def backend_compile_count() -> int:
+    """XLA backend compilations observed since the hook was installed."""
+    with _counts_lock:
+        return _counts["backend_compiles"]
+
+
+def compile_cache_stats() -> Dict[str, int]:
+    """Snapshot of the compile counters (installs the hooks first, so the
+    first caller anchors counting at zero)."""
+    install_compile_hook()
+    with _counts_lock:
+        return dict(_counts)
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir`` (the
+    ``compile_cache_dir`` config param) and install the counters. Every
+    compile is made cacheable (no min-time/min-size floor) so a warm
+    directory means zero backend compiles on restart. Idempotent;
+    returns False when ``cache_dir`` is empty."""
+    if not cache_dir:
+        return False
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", os.fspath(cache_dir))
+    for name, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(name, val)
+        except Exception:  # noqa: BLE001 - knob absent in this jax version
+            pass
+    install_compile_hook()
+    return True
 
 
 def _timed(fn, *args, reps=3, **kw) -> float:
@@ -44,9 +126,11 @@ def latency_summary(samples_ms) -> Dict[str, float]:
 def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
     """Per-phase seconds for one boosting iteration's building blocks, using
     the booster's actual data/shapes. Keys: grad, hist_full,
-    partition_hist_fused, hist_leaf_half, find_split, plus frontier_hist /
-    frontier_waves / frontier_sweeps_per_tree when the booster grows in
-    frontier mode (docs/Performance.md describes each)."""
+    partition_hist_fused, hist_leaf_half, find_split,
+    compile_cache_hits/misses, plus frontier_hist / frontier_hist_w<k> /
+    frontier_waves / frontier_sweeps_per_tree / frontier_wave_occupancy /
+    frontier_slot_sweeps_per_tree when the booster grows in frontier mode
+    (docs/Performance.md describes each)."""
     from .core.histogram import build_histogram
     from .core.partition import (frontier_slots_from_partition, hist_for_leaf,
                                  init_partition, make_row_gather,
@@ -116,34 +200,63 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
                 params.row_chunk, impl=params.hist_impl)), part2)
 
         if getattr(params, "frontier_mode", False):
+            from . import bucketing
             from .core.histogram import build_histogram_frontier
             # the frontier wave cost: the partition hands the builder the
-            # wave's LEAF IDS and one leaf-indexed sweep prices them all —
-            # probed at full wave width (every leaf can split)
-            n_slots = max(params.num_leaves - 1, 1)
-            slots = frontier_slots_from_partition(
-                part2, jnp.arange(n_slots, dtype=jnp.int32), n)
-            out["frontier_hist"] = _timed(
-                build_histogram_frontier, xb, slots, g, h, mask,
-                num_bins=params.num_bins, num_slots=n_slots,
-                row_chunk=params.row_chunk, impl=params.hist_impl)
+            # wave's LEAF IDS and one leaf-indexed sweep prices them all.
+            # kb is the clamped maximum wave width; with bucketing on,
+            # early waves run at the smaller pow-2 ladder widths, so the
+            # per-width probes below show the per-sweep cost the grower
+            # actually pays per wave
+            bucketed = getattr(params, "frontier_bucketing", False)
+            kb = bucketing.frontier_max_width(params.num_leaves,
+                                              params.max_depth)
+            ladder = (bucketing.wave_width_ladder(params.num_leaves,
+                                                  params.max_depth)
+                      if bucketed else [kb])
+            for w in sorted({ladder[0], ladder[len(ladder) // 2],
+                             ladder[-1]}):
+                slots_w = frontier_slots_from_partition(
+                    part2, jnp.arange(w, dtype=jnp.int32), n)
+                t_w = _timed(
+                    build_histogram_frontier, xb, slots_w, g, h, mask,
+                    num_bins=params.num_bins, num_slots=w,
+                    row_chunk=params.row_chunk, impl=params.hist_impl)
+                out["frontier_hist_w%d" % w] = t_w
+                if w == ladder[-1]:      # full width: the pre-bucketing key
+                    out["frontier_hist"] = t_w
             # dataset sweeps per tree scale with DEPTH, not leaf count:
             # wave w splits the leaves created in wave w-1, so waves = max
-            # leaf depth of the grown tree, sweeps = waves + 1 (the root)
+            # leaf depth of the grown tree, sweeps = waves + 1 (the root).
+            # An internal node's depth IS the wave that committed it (every
+            # positive-gain leaf splits at the first wave after it
+            # appears), so per-depth internal-node counts reconstruct each
+            # wave's live width exactly.
             if booster.models:
                 t0 = booster.models[0]
-                waves = 0
-                stack = [(0, 1)] if t0.num_leaves > 1 else []
+                live_at: Dict[int, int] = {}
+                stack = [(0, 0)] if t0.num_leaves > 1 else []
                 while stack:
                     nd, d = stack.pop()
+                    live_at[d] = live_at.get(d, 0) + 1
                     for ch in (int(t0.left_child[nd]),
                                int(t0.right_child[nd])):
-                        if ch < 0:       # ~leaf encoding: negative = leaf
-                            waves = max(waves, d)
-                        else:
+                        if ch >= 0:      # ~leaf encoding: negative = leaf
                             stack.append((ch, d + 1))
+                waves = (max(live_at) + 1) if live_at else 0
                 out["frontier_waves"] = float(waves)
                 out["frontier_sweeps_per_tree"] = float(waves + 1)
+                live = [live_at.get(w, 0) for w in range(waves)]
+                paid = [(bucketing.wave_width_bucket(
+                            lv, params.num_leaves, params.max_depth)
+                         if bucketed else kb) for lv in live]
+                # occupancy: live slots / paid bucket width, occupancy-
+                # weighted over the tree's waves; slot_sweeps is what the
+                # hist builder actually swept (fixed width pays waves*kb)
+                out["frontier_wave_occupancy"] = (
+                    float(sum(live)) / max(float(sum(paid)), 1.0))
+                out["frontier_slot_sweeps_per_tree"] = float(sum(paid))
+                out["frontier_slot_sweeps_fixed_width"] = float(waves * kb)
 
         sum_g = jnp.sum(g)
         sum_h = jnp.sum(h)
@@ -155,6 +268,12 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
         # find_split works on per-feature views; without EFB hist == view
         if not params.with_efb:
             out["find_split"] = _timed(split_fn, hist)
+
+        # persistent-compile-cache accounting (compile_cache_dir): both
+        # stay 0 unless the cache is enabled; a warm cache shows as hits
+        stats = compile_cache_stats()
+        out["compile_cache_hits"] = float(stats["persistent_cache_hits"])
+        out["compile_cache_misses"] = float(stats["persistent_cache_misses"])
 
         # checkpoint overhead (lightgbm_tpu.checkpoint): one full-state
         # snapshot save + restore on the booster's real model/shapes, so

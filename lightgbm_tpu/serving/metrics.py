@@ -21,36 +21,11 @@ import threading
 import time
 from typing import Dict, Optional
 
-from ..profiling import latency_summary
-
-_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_compile_count = 0
-_hook_lock = threading.Lock()
-_hook_installed = False
-
-
-def _on_event_duration(event: str, duration: float, **kwargs) -> None:
-    global _compile_count
-    if event == _BACKEND_COMPILE_EVENT:
-        with _hook_lock:
-            _compile_count += 1
-
-
-def install_compile_hook() -> None:
-    """Register the backend-compile listener (idempotent, process-wide)."""
-    global _hook_installed
-    with _hook_lock:
-        if _hook_installed:
-            return
-        _hook_installed = True
-    import jax.monitoring
-    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
-
-
-def backend_compile_count() -> int:
-    """XLA backend compilations observed since the hook was installed."""
-    with _hook_lock:
-        return _compile_count
+# the hook itself lives in profiling (training's zero-recompile invariant
+# and the persistent-cache counters share it); re-exported here because
+# serving callers (serve_smoke, tests) learned these names first
+from ..profiling import (backend_compile_count,  # noqa: F401
+                         install_compile_hook, latency_summary)
 
 
 class ServingMetrics:
@@ -103,12 +78,12 @@ class ServingMetrics:
         """Anchor the recompile counter: compiles past this point are
         recompiles (the serve_smoke.py zero-recompile assertion)."""
         with self._lock:
-            self._compile_floor = _compile_count
+            self._compile_floor = backend_compile_count()
             self._miss_floor = self.cache_misses
 
     def recompiles_after_warmup(self) -> int:
         with self._lock:
-            return _compile_count - self._compile_floor
+            return backend_compile_count() - self._compile_floor
 
     def cache_misses_after_warmup(self) -> int:
         with self._lock:
@@ -131,9 +106,9 @@ class ServingMetrics:
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,
                 "errors": self.errors,
-                "backend_compiles": _compile_count,
+                "backend_compiles": backend_compile_count(),
                 "recompiles_after_warmup":
-                    _compile_count - self._compile_floor,
+                    backend_compile_count() - self._compile_floor,
                 "latency_ms": lat,
             }
 

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..bucketing import pow2_bucket, pow2_ladder
 from ..core import tree as tree_mod
 from ..log import LightGBMError, check
 from ..parallel.mesh import replicated, row_sharding, serving_mesh
@@ -41,23 +42,16 @@ from .registry import ModelBundle, ModelRegistry
 
 def bucket_rows(n: int, min_bucket: int = 16, max_batch: int = 4096) -> int:
     """Power-of-two padded size for an ``n``-row request (chunks of
-    ``max_batch`` beyond the cap)."""
+    ``max_batch`` beyond the cap). Thin wrapper over the shared
+    ``lightgbm_tpu.bucketing`` ladder, which the frontier grower's wave
+    widths also ride."""
     check(n >= 1, "empty prediction request")
-    b = max(int(min_bucket), 1)
-    while b < n:
-        b <<= 1
-    return min(b, int(max_batch))
+    return pow2_bucket(n, min_bucket, max_batch)
 
 
 def bucket_sizes(min_bucket: int = 16, max_batch: int = 4096) -> List[int]:
     """Every bucket the cache can produce — the warmup schedule."""
-    out = []
-    b = max(int(min_bucket), 1)
-    while b < int(max_batch):
-        out.append(b)
-        b <<= 1
-    out.append(int(max_batch))
-    return out
+    return pow2_ladder(min_bucket, max_batch)
 
 
 class _CompiledPredictor:

@@ -1,0 +1,112 @@
+"""Cold-vs-warm persistent-compile-cache smoke (tier1 CI).
+
+Runs a tiny 2-iteration frontier training probe with ``compile_cache_dir``
+pointed at a shared directory and emits one JSON object describing the
+compile accounting. The CI workflow runs it TWICE with the same directory:
+
+- run 1 (cold): populates the cache; asserts the in-process invariant that
+  a second ``train_many`` window after warmup performs ZERO backend
+  compiles (all wave-width buckets compiled up front);
+- run 2 (``--expect-warm``): additionally asserts every compile request was
+  served from the persistent cache (zero misses), i.e. a restarted process
+  recompiles nothing — the cross-process half of "zero recompiles after
+  warmup".
+
+Exit code 0 = all assertions hold; 1 = a compile invariant broke. The JSON
+goes to ``--out`` (and stdout) so CI can upload it as an artifact.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # repo root for lightgbm_tpu
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cache-dir", required=True,
+                    help="shared persistent compile cache directory")
+    ap.add_argument("--out", default="", help="write the probe JSON here")
+    ap.add_argument("--expect-warm", action="store_true",
+                    help="assert zero persistent-cache misses (run 2)")
+    ap.add_argument("--iters", type=int, default=2)
+    args = ap.parse_args()
+
+    # cache + counters BEFORE any compile (binning jits too), so the
+    # persistent cache covers the whole probe, not just training
+    from lightgbm_tpu.profiling import (backend_compile_count,
+                                        compile_cache_stats,
+                                        enable_compile_cache,
+                                        install_compile_hook)
+    install_compile_hook()
+    enable_compile_cache(args.cache_dir)
+
+    import jax
+    import numpy as np
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objectives import create_objective
+
+    r = np.random.RandomState(0)
+    n, f = 5000, 10
+    X = r.randn(n, f).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * r.randn(n)) > 0) \
+        .astype(np.float32)
+
+    cfg = Config({"objective": "binary", "num_leaves": 31, "verbosity": -1,
+                  "tree_growth": "frontier",
+                  "compile_cache_dir": args.cache_dir})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg), [])
+
+    t0 = time.time()
+    b.train_many(args.iters)          # compiles + pre-warms the ladder
+    jax.block_until_ready(b.scores)
+    warmup_s = time.time() - t0
+    floor = backend_compile_count()
+
+    t0 = time.time()
+    b.train_many(args.iters)          # must reuse every executable
+    jax.block_until_ready(b.scores)
+    train_s = time.time() - t0
+
+    recompiles = backend_compile_count() - floor
+    stats = compile_cache_stats()
+    ladder = getattr(b, "_ladder_warmup", None) or {}
+    result = {
+        "iters": args.iters,
+        "expect_warm": bool(args.expect_warm),
+        "warmup_s": round(warmup_s, 3),
+        "train_s": round(train_s, 3),
+        "backend_compiles_total": stats["backend_compiles"],
+        "recompiles_after_warmup": recompiles,
+        "compile_cache_hits": stats["persistent_cache_hits"],
+        "compile_cache_misses": stats["persistent_cache_misses"],
+        "frontier_wave_ladder": list(ladder.get("widths", [])),
+        "frontier_ladder_compiles": {
+            str(w): c for w, c in
+            ladder.get("per_bucket_compiles", {}).items()},
+    }
+    errors = []
+    if recompiles != 0:
+        errors.append("%d XLA compiles after warmup (expected 0)"
+                      % recompiles)
+    if args.expect_warm and stats["persistent_cache_misses"] != 0:
+        errors.append("%d persistent-cache misses on a warm cache "
+                      "(expected 0)" % stats["persistent_cache_misses"])
+    if errors:
+        result["errors"] = errors
+    line = json.dumps(result, sort_keys=True)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
