@@ -237,6 +237,33 @@ def run_bench(backend_info: dict) -> dict:
     ladder_info = getattr(b, "_ladder_warmup", None) or {}
     cache_stats = compile_cache_stats()
 
+    # observability=basic overhead (ISSUE 5 acceptance: < 3% vs none).
+    # A second booster over the SAME binned dataset with telemetry on —
+    # spans + per-block sync + fused health vector — timed identically
+    # (warmup window excluded, best of two windows).
+    obs_overhead = {}
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        try:
+            cfg_obs = Config(dict(cfg_d, observability="basic"))
+            b_obs = create_boosting(cfg_obs, ds,
+                                    create_objective(cfg_obs), [])
+            b_obs.train_many(iters)
+            jax.block_until_ready(b_obs.scores)
+            obs_windows = []
+            for _ in range(2):
+                t0 = time.time()
+                b_obs.train_many(iters)
+                jax.block_until_ready(b_obs.scores)
+                obs_windows.append(time.time() - t0)
+            dt_obs = min(obs_windows)
+            obs_overhead = {
+                "train_%d_iters_obs_basic" % iters: round(dt_obs, 3),
+                "obs_basic_windows": [round(w, 3) for w in obs_windows],
+                "obs_basic_overhead_frac": round((dt_obs - dt) / dt, 5),
+            }
+        except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
+            obs_overhead = {"obs_error": repr(e)[:200]}
+
     iters_per_sec = iters / dt
     higgs_equiv = iters_per_sec * (n / HIGGS_ROWS)
     vs_baseline = higgs_equiv / BASELINE_ITERS_PER_SEC
@@ -347,6 +374,7 @@ def run_bench(backend_info: dict) -> dict:
         "raw_iters_per_sec": round(iters_per_sec, 4),
         "rows_features_per_sec_per_chip": round(iters_per_sec * n * f, 1),
         "train_recompiles_after_warmup": int(train_recompiles),
+        **obs_overhead,
         "compile_cache_hits": int(cache_stats["persistent_cache_hits"]),
         "compile_cache_misses": int(cache_stats["persistent_cache_misses"]),
         **({"frontier_wave_ladder": list(ladder_info["widths"]),

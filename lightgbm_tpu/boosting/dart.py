@@ -103,20 +103,23 @@ class DART(GBDT):
         k_cls = self.num_tree_per_iteration
         drop_index = self._dropping_trees()
         k = float(len(drop_index))
+        self.obs.event("dart_drop", iteration=self.iter_,
+                       dropped=len(drop_index))
 
         # remove dropped trees from train/valid scores (DroppingTrees :125-131)
         train_deltas = {}   # (iter i, class c) -> [N] device array
         valid_deltas = {}
-        for i in drop_index:
-            for c in range(k_cls):
-                ht = self.models[i * k_cls + c]
-                d = self._tree_delta(ht, self.xb)
-                train_deltas[(i, c)] = d
-                self.scores = self.scores.at[:, c].add(-d)
-                for vi, cache in self._valid_pred_cache.items():
-                    dv = self._tree_delta(ht, cache["xb"])
-                    valid_deltas[(vi, i, c)] = dv
-                    cache["scores"] = cache["scores"].at[:, c].add(-dv)
+        with self.obs.span("dart_drop_adjust", dropped=len(drop_index)):
+            for i in drop_index:
+                for c in range(k_cls):
+                    ht = self.models[i * k_cls + c]
+                    d = self._tree_delta(ht, self.xb)
+                    train_deltas[(i, c)] = d
+                    self.scores = self.scores.at[:, c].add(-d)
+                    for vi, cache in self._valid_pred_cache.items():
+                        dv = self._tree_delta(ht, cache["xb"])
+                        valid_deltas[(vi, i, c)] = dv
+                        cache["scores"] = cache["scores"].at[:, c].add(-dv)
 
         # new-tree shrinkage (dart.hpp :133-139)
         if not cfg.xgboost_dart_mode:

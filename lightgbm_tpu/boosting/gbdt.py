@@ -253,6 +253,11 @@ class GBDT:
         self._use_input_grads = False
         self.mesh = None
         self._row_valid = None
+        # observability facade (lightgbm_tpu.obs): replaced by the
+        # config-driven one in _setup_train; loaded/predict-only boosters
+        # keep the disabled no-op
+        from ..obs.runtime import TrainingObs
+        self.obs = TrainingObs.disabled()
 
         if train_data is not None:
             self._setup_train(train_data)
@@ -428,6 +433,12 @@ class GBDT:
             and cfg.tree_learner == "data"
             and mesh_mod.DATA_AXIS in self.mesh.axis_names)
 
+        # observability: built before grow_params so the device-side
+        # health piggy-back (GrowParams.obs_health) keys off the resolved
+        # health action
+        from ..obs.runtime import TrainingObs
+        self.obs = TrainingObs.from_config(cfg)
+
         # resolved once: _resolve_hist_impl logs a user-facing warning on
         # the f64-routes-off-pallas path, which must not repeat per call
         hist_impl = _resolve_hist_impl(cfg)
@@ -489,7 +500,13 @@ class GBDT:
             packed_features=tuple(
                 int(i) for i in np.nonzero(
                     np.asarray(self.feature_meta.pack_mod))[0])
-            if self.feature_meta.pack_mod is not None else ())
+            if self.feature_meta.pack_mod is not None else (),
+            # frontier health piggy-back rides the single-device /
+            # GSPMD growth call; the explicit shard_map learner slices
+            # the aux slot off, so it stays off there (iteration-level
+            # grad/hess health still applies on every path)
+            obs_health=(frontier_mode and not self._partition_on_mesh
+                        and self.obs.health_enabled))
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -762,6 +779,11 @@ class GBDT:
             self.xb, tuple(getattr(obj, nm) for nm in obj_row_names),
             self._fp_capture)
         import copy as _copy
+        # device-side health flags (lightgbm_tpu.obs): computed from
+        # values the step already holds — two reductions over grad/hess
+        # plus the grower's aux accumulator. Off: the step returns a
+        # constant zero vector and no health compute enters the program.
+        health_on = self.obs.health_enabled
         is_goss = self.boosting_type == "goss"
         if is_goss:
             # counts from the REAL row count, not the mesh-padding-inflated
@@ -970,6 +992,12 @@ class GBDT:
                 trees, leaf_ids, cegb_out = lax.map(
                     lambda gh: grow_one(gh[0], gh[1], cegb_state),
                     (g.T, h.T))
+            # the grower's third output is CEGB state on the exact path
+            # and the [K, 2] health accumulator on the frontier path with
+            # obs_health (the two are config-exclusive)
+            grower_health = None
+            if params.frontier_mode and params.obs_health:
+                grower_health, cegb_out = cegb_out, None
             if cegb_state is not None:
                 # classes train from the iteration-start state; acquisitions
                 # merge across class trees for the next iteration (the
@@ -1017,8 +1045,13 @@ class GBDT:
             stopped_out = stopped_in | ~any_split
             apply = (any_split & ~stopped_in).astype(jnp.float32)
             new_scores = scores + deltas.T * apply
+            if health_on:
+                from ..obs.health import health_vec
+                health = health_vec(g, h, any_split, grower_health)
+            else:
+                health = jnp.zeros((4,), jnp.float32)
             return pack_trees(trees), leaf_ids, new_scores, cegb_new, \
-                stopped_out
+                stopped_out, health
 
         self._iter_core = run_iter   # unjitted: train_many scans over it
         return jax.jit(run_iter)
@@ -1059,16 +1092,19 @@ class GBDT:
                         .astype(jnp.float32)
                     bag_mask = jnp.where(refresh, new_mask, bag_mask)
                 sm = bag_mask if row_valid is None else bag_mask * row_valid
-                packed, _leaf_ids, sc2, cegb2, stopped2 = core(
+                packed, _leaf_ids, sc2, cegb2, stopped2, health = core(
                     xb, obj_rows, fp_capture, sc, sm, fm, g0, h0, lr, ga,
                     gkey, cegb, stopped)
-                return (sc2, bag_mask, cegb2, stopped2), packed
+                return (sc2, bag_mask, cegb2, stopped2), (packed, health)
 
-            carry, packs = lax.scan(
+            carry, (packs, healths) = lax.scan(
                 step, (scores, bag_mask0, cegb_state, stopped_in),
                 (feature_masks, goss_actives, iter_idxs, keys))
             new_scores, bag_mask, cegb_out, stopped_out = carry
-            return packs, new_scores, bag_mask, cegb_out, stopped_out
+            # healths: [block, 4] per-iteration health vectors (zeros when
+            # monitoring is off) — one tiny transfer per block, not per iter
+            return packs, healths, new_scores, bag_mask, cegb_out, \
+                stopped_out
 
         # donate the block's threaded train-state buffers (scores [N, K]
         # and the bagging mask [N]) — both are rebound to the block's
@@ -1151,6 +1187,11 @@ class GBDT:
         """
         eligible = (self.boosting_type in ("gbdt", "goss")
                     and not self._use_input_grads)
+        if eligible and self.obs.per_iteration:
+            # observability=full wants TRUE per-iteration spans and
+            # health-within-one-iteration, so it forgoes block fusion —
+            # that cost is the documented basic/full trade
+            eligible = False
         if not eligible:
             for _ in range(num_iters):
                 if self.train_one_iter():
@@ -1181,17 +1222,37 @@ class GBDT:
                                          dtype=np.int32))
             all_keys = jax.random.split(self._bag_key, block + 1)
             self._bag_key = all_keys[0]
-            packs, self.scores, self._bag_mask, self._cegb_state, \
-                self._stopped_dev = fn(
-                    *self._iter_capture,
-                    self.scores, fmasks, gactive, idxs, all_keys[1:],
-                    self._bag_mask, self._cegb_state, self._stopped_dev,
-                    jnp.float32(self.shrinkage_rate))
+            obs = self.obs
+            obs.perfetto_step(self.iter_, self.iter_ + block)
+            t0 = time.perf_counter() if obs.enabled else 0.0
+            with obs.span("train_block", start_iter=self.iter_,
+                          count=block):
+                packs, healths, self.scores, self._bag_mask, \
+                    self._cegb_state, self._stopped_dev = fn(
+                        *self._iter_capture,
+                        self.scores, fmasks, gactive, idxs, all_keys[1:],
+                        self._bag_mask, self._cegb_state, self._stopped_dev,
+                        jnp.float32(self.shrinkage_rate))
+                if obs.enabled:
+                    # one sync at span close; basic mode's only added
+                    # barrier, and the block boundary already is one for
+                    # the flush cadence
+                    jax.block_until_ready(self.scores)
             self._pending.append({"packed": packs,
                                   "shrinkage": self.shrinkage_rate,
                                   "count": block})
             self.iter_ += block
             done += block
+            if obs.enabled:
+                hrows = np.asarray(healths)
+                obs.dispatch_done(self.iter_ - block, block,
+                                  time.perf_counter() - t0,
+                                  health_rows=hrows)
+                obs.record_hbm()
+                obs.check_health(hrows, self.iter_ - block, booster=self)
+            elif obs.health_enabled:
+                obs.check_health(np.asarray(healths), self.iter_ - block,
+                                 booster=self)
             if sum(p.get("count", 1) for p in self._pending) \
                     >= self._flush_every:
                 self._materialize()
@@ -1328,6 +1389,27 @@ class GBDT:
                 "(engine.train(resume_from=<dir>)) for exact continuation.",
                 ", ".join(lost))
 
+    def enable_health_monitor(self, action: str = "warn") -> None:
+        """Arm device-side health monitoring (``callback.health_monitor``).
+        When armed before the first compile — the callback's
+        ``before_iteration`` slot at iteration 0 — nothing rebuilds; arming
+        mid-train discards the compiled step so the health branch enters
+        the program from the next dispatch."""
+        if not self.obs.arm_health(action):
+            return
+        if self._compiled_iter is not None or \
+                self._compiled_block is not None:
+            Log.warning("health_monitor armed after compilation; "
+                        "rebuilding the training step with device-side "
+                        "health flags")
+        self._compiled_iter = None
+        self._iter_core = None
+        self._compiled_block = None
+        if getattr(self, "grow_params", None) is not None \
+                and self.grow_params.frontier_mode \
+                and not self._partition_on_mesh:
+            self.grow_params = self.grow_params._replace(obs_health=True)
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp TrainOneIter:333-412).
@@ -1364,13 +1446,22 @@ class GBDT:
             h_in = jnp.ones((n, k), jnp.float32)
 
         self._bag_key, goss_key = jax.random.split(self._bag_key)
-        packed, leaf_ids, new_scores, cegb_new, self._stopped_dev = \
-            self._compiled_iter(
-                *self._iter_capture,
-                self.scores, sample_mask, feature_mask, g_in, h_in,
-                jnp.float32(self.shrinkage_rate),
-                jnp.float32(self._goss_active(iter_idx)), goss_key,
-                self._cegb_state, self._stopped_dev)
+        obs = self.obs
+        obs.perfetto_step(iter_idx, iter_idx + 1)
+        t0 = time.perf_counter() if obs.enabled else 0.0
+        with obs.span("train_iter", iteration=iter_idx):
+            packed, leaf_ids, new_scores, cegb_new, self._stopped_dev, \
+                health = self._compiled_iter(
+                    *self._iter_capture,
+                    self.scores, sample_mask, feature_mask, g_in, h_in,
+                    jnp.float32(self.shrinkage_rate),
+                    jnp.float32(self._goss_active(iter_idx)), goss_key,
+                    self._cegb_state, self._stopped_dev)
+            if obs.enabled:
+                # span-close sync: the per-iteration path is already the
+                # slow (full/host-logic) path, so one barrier per
+                # iteration is the accepted cost of true spans
+                jax.block_until_ready(new_scores)
         self.scores = new_scores
         self._cegb_state = cegb_new
 
@@ -1379,6 +1470,16 @@ class GBDT:
                                 "count": 1}
         self._pending.append(pend)
         self.iter_ += 1
+        if obs.enabled:
+            hrow = np.asarray(health)[None]
+            obs.dispatch_done(iter_idx, 1, time.perf_counter() - t0,
+                              health_rows=hrow)
+            if obs.per_iteration:
+                obs.record_hbm()
+            obs.check_health(hrow, iter_idx, booster=self)
+        elif obs.health_enabled:
+            obs.check_health(np.asarray(health)[None], iter_idx,
+                             booster=self)
         if sum(p["count"] for p in self._pending) >= self._flush_every:
             return self._materialize()
         return False
@@ -1397,8 +1498,9 @@ class GBDT:
         l = self.config.num_leaves
         # every pending entry is a [B_i, K, T] block (B_i == 1 for
         # per-iteration dispatches); ONE transfer for the whole backlog
-        buf = np.asarray(jnp.concatenate([p["packed"] for p in pend],
-                                         axis=0))  # [sum(B_i), K, T]
+        with self.obs.span("materialize", blocks=len(pend)):
+            buf = np.asarray(jnp.concatenate([p["packed"] for p in pend],
+                                             axis=0))  # [sum(B_i), K, T]
         row = 0
         for p in pend:
             if self._stopped:

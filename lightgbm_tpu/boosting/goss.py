@@ -23,8 +23,16 @@ class GOSS(GBDT):
             raise LightGBMError("Cannot use bagging in GOSS")
         if not (config.top_rate > 0.0 and config.other_rate > 0.0):
             raise LightGBMError("GOSS needs top_rate > 0 and other_rate > 0")
+        self._goss_activated_logged = False
         super().__init__(config, train_data, objective, metrics)
 
     def _goss_active(self, iter_idx: int) -> float:
         warmup = int(1.0 / max(self.config.learning_rate, 1e-12))
-        return 1.0 if iter_idx >= warmup else 0.0
+        active = iter_idx >= warmup
+        if active and not self._goss_activated_logged:
+            # one obs event at the warmup->sampling transition — bagging
+            # semantics change here, worth a mark on the event stream
+            self._goss_activated_logged = True
+            self.obs.event("goss_sampling_active", iteration=iter_idx,
+                           warmup_iters=warmup)
+        return 1.0 if active else 0.0

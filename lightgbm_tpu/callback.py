@@ -246,6 +246,38 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     return _EarlyStopping(stopping_rounds, first_metric_only, verbose)
 
 
+class _HealthMonitor:
+    """Arms device-side health monitoring on the booster. Runs in the
+    ``before_iteration`` slot so the FIRST call lands before the first
+    compile — the health branch enters the initial program for free.  Its
+    presence also disables engine-side block fusion (it is a
+    before-callback), which is exactly what "flag within 1 iteration"
+    requires."""
+
+    before_iteration = True
+    order = 5
+
+    def __init__(self, action: str = "warn"):
+        self.action = action
+        self._armed = False
+
+    def __call__(self, env) -> None:
+        if self._armed:
+            return
+        impl = getattr(env.model, "_impl", env.model)
+        impl.enable_health_monitor(self.action)
+        self._armed = True
+
+
+def health_monitor(action: str = "warn") -> Callable:
+    """Watch training health (non-finite grad/hess, degenerate gains) via
+    device-side flags fused into the training step (lightgbm_tpu.obs).
+    ``action``: ``warn`` logs and counts; ``abort`` checkpoints into
+    ``checkpoint_dir`` (when configured) then raises; ``raise`` raises
+    immediately. See docs/Observability.md."""
+    return _HealthMonitor(action)
+
+
 def checkpoint(directory: str, period: int = 1, keep_last_n: int = 3,
                on_sigterm: bool = True) -> Callable:
     """Preemption-safe training snapshots (lightgbm_tpu.checkpoint): save

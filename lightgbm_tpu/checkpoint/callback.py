@@ -26,6 +26,11 @@ from ..log import Log
 from .manager import CheckpointManager
 
 
+def _null_span():
+    from ..obs.trace import _NULL_SPAN
+    return _NULL_SPAN
+
+
 class _Checkpoint:
     before_iteration = False
     order = 25
@@ -107,8 +112,16 @@ class _Checkpoint:
             es = self._early_stopping_state(env)
             if es is not None:
                 train_loop["early_stopping"] = es
-            self.manager.save(env.model, train_loop=train_loop,
-                              eval_entry=eval_entry)
+            obs = getattr(env.model._impl, "obs", None)
+            span = (obs.span("checkpoint_save", iteration=it)
+                    if obs is not None else _null_span())
+            with span:
+                self.manager.save(env.model, train_loop=train_loop,
+                                  eval_entry=eval_entry)
+            from ..obs.registry import get_registry
+            get_registry().counter(
+                "lgbm_checkpoint_saves_total",
+                "Training checkpoints written.").inc()
         if self._sigterm:
             Log.warning("checkpoint: SIGTERM received; snapshot saved at "
                         "iteration %d in %s; exiting", it,

@@ -126,6 +126,12 @@ def run_train(config: Config, params: Dict) -> None:
         callbacks.append(checkpoint_cb(config.checkpoint_dir,
                                        period=config.checkpoint_period,
                                        keep_last_n=config.checkpoint_keep))
+    if config.health_monitor in ("abort", "raise"):
+        # escalating health actions want per-iteration detection; the
+        # callback's presence forces the per-iteration loop and arms the
+        # device-side flags before the first compile
+        from .callback import health_monitor
+        callbacks.append(health_monitor(config.health_monitor))
 
     booster = engine.train(
         dict(params), train_set,
@@ -140,6 +146,11 @@ def run_train(config: Config, params: Dict) -> None:
         resume_from=(config.resume or None))
     booster.save_model(config.output_model)
     Log.info("Finished training; model saved to %s", config.output_model)
+    obs = getattr(booster._impl, "obs", None)
+    if obs is not None and obs.enabled and obs.monitor is not None:
+        Log.info("Telemetry: %d health anomalies (%d reports); see "
+                 "docs/Observability.md", obs.monitor.anomaly_count(),
+                 len(obs.monitor.reports))
 
 
 def run_predict(config: Config, params: Dict) -> None:

@@ -224,12 +224,33 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("serve_warmup", bool, True, []),         # compile all buckets at boot
     ("serve_metrics_file", str, "", []),      # JSON-lines metrics sink
     ("serve_metrics_freq", float, 10.0, []),  # seconds between snapshots
+    # ---- observability (lightgbm_tpu.obs; docs/Observability.md) ----
+    # none: zero instrumentation (default). basic: fused blocks kept,
+    # per-block spans/events/health (<3% overhead, bench-verified).
+    # full: per-iteration dispatch with true spans, health within one
+    # iteration, Perfetto window capture, per-iteration HBM accounting.
+    ("observability", str, "none", ["obs", "observability_level"]),
+    # JSON-lines event stream (spans, iterations, health); "" = off
+    ("obs_event_file", str, "", ["obs_events", "observability_event_file"]),
+    # training stats HTTP endpoint: -1 = off, 0 = OS-assigned port
+    ("obs_stats_port", int, -1, ["obs_metrics_port"]),
+    # jax.profiler Perfetto capture (observability=full): directory,
+    # first iteration and iteration count of the capture window
+    ("obs_perfetto_dir", str, "", ["obs_trace_dir"]),
+    ("obs_perfetto_start", int, 0, []),
+    ("obs_perfetto_iters", int, 0, []),       # 0 = no capture
+    # device-side anomaly response: auto = warn when observability is on,
+    # else off; abort = checkpoint (checkpoint_dir) then raise
+    ("health_monitor", str, "auto",
+     ["health_monitor_action", "obs_health"]),
 ]
 
 # known spellings, validated in _post_process (a typo'd kernel or growth
 # mode must fail loudly at config time, not fall through to some default
 # deep in the dispatch)
 TREE_GROW_MODES = ("exact", "batched", "frontier")
+OBSERVABILITY_LEVELS = ("none", "basic", "full")
+HEALTH_MONITOR_ACTIONS = ("auto", "none", "warn", "abort", "raise")
 HIST_IMPLS = ("auto", "matmul", "scatter", "pallas", "pallas_highest",
               "pallas_interpret", "pallas_highest_interpret")
 
@@ -440,8 +461,27 @@ class Config:
         if self.checkpoint_keep < 1:
             raise LightGBMError("checkpoint_keep should be >= 1, got %s"
                                 % self.checkpoint_keep)
-        if self.verbosity >= 0:
-            Log.reset_level(self.verbosity)
+        self.observability = str(self.observability).strip().lower()
+        if self.observability not in OBSERVABILITY_LEVELS:
+            raise LightGBMError("observability should be one of %s, got %s"
+                                % ("/".join(OBSERVABILITY_LEVELS),
+                                   self.observability))
+        self.health_monitor = str(self.health_monitor).strip().lower()
+        if self.health_monitor not in HEALTH_MONITOR_ACTIONS:
+            raise LightGBMError("health_monitor should be one of %s, got %s"
+                                % ("/".join(HEALTH_MONITOR_ACTIONS),
+                                   self.health_monitor))
+        if not -1 <= self.obs_stats_port <= 65535:
+            raise LightGBMError("obs_stats_port should be in [-1, 65535] "
+                                "(-1 = off, 0 = OS-assigned), got %s"
+                                % self.obs_stats_port)
+        if self.obs_perfetto_start < 0 or self.obs_perfetto_iters < 0:
+            raise LightGBMError("obs_perfetto_start/obs_perfetto_iters "
+                                "should be >= 0")
+        # verbosity drives the process logger unconditionally so
+        # verbosity=-1 (fatal-only) also silences obs warnings; previously
+        # negative values were dropped and warnings leaked through
+        Log.reset_level(self.verbosity)
 
     def copy(self) -> "Config":
         return copy.deepcopy(self)

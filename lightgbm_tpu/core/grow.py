@@ -140,6 +140,14 @@ class GrowParams(NamedTuple):
     # stay off under vmapped_classes — vmap lowers switch to
     # execute-all-branches, which would cost MORE than fixed width.
     frontier_bucketing: bool = False
+    # observability health piggy-back (lightgbm_tpu.obs): the frontier
+    # wave loop threads a 2-scalar (waves executed, nonfinite committed
+    # gain) accumulator through its carry and returns it in the aux slot.
+    # The accumulator derives from the gains the wave already computed
+    # from its psum'd histograms, so the per-wave collective count is
+    # unchanged (pinned by tests/test_obs.py). Off: aux slot stays None
+    # and the compiled program is identical to an uninstrumented build.
+    obs_health: bool = False
 
 
 class TreeArrays(NamedTuple):

@@ -85,6 +85,16 @@ class _FrontierState(NamedTuple):
     tree: TreeArrays
     leaf_min: jnp.ndarray     # [L] f32 monotone lower bound
     leaf_max: jnp.ndarray     # [L] f32 monotone upper bound
+    # [2] f32 (waves executed, nonfinite committed gain) when
+    # params.obs_health, else None (empty pytree leaf — the carry and the
+    # compiled program are unchanged when monitoring is off)
+    health: Optional[jnp.ndarray] = None
+
+
+def _gain_anomaly(gain: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise "this gain is corrupt": NaN or +inf. -inf is the
+    K_MIN_SCORE no-valid-split sentinel and therefore healthy."""
+    return jnp.isnan(gain) | (gain == jnp.inf)
 
 
 def _route_rows_gather(xb, rs, cur, meta, with_efb, with_categorical):
@@ -122,11 +132,14 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
                        meta: FeatureMeta, feature_mask: jnp.ndarray,
                        params: GrowParams,
                        axis_name: Optional[str] = None,
-                       ) -> Tuple[TreeArrays, jnp.ndarray, None]:
+                       ) -> Tuple[TreeArrays, jnp.ndarray,
+                                  Optional[jnp.ndarray]]:
     """Grow one tree in frontier waves: every positive-gain frontier
     leaf splits per sequential step, with ONE batched histogram pass per
     wave. Same contract as grow.grow_tree (minus forced/CEGB); returns
-    (tree, final per-row leaf_id, None)."""
+    (tree, final per-row leaf_id, aux) where aux is the [2] f32 health
+    accumulator (waves executed, nonfinite committed gain) when
+    ``params.obs_health`` and None otherwise."""
     n, ncols = xb.shape
     l = params.num_leaves
     b = params.num_bins
@@ -173,10 +186,21 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
     leaf_id0 = jnp.zeros((n,), jnp.int32)
     if axis_name is not None:
         leaf_id0 = pcast(leaf_id0, (axis_name,), to="varying")
+    # health accumulator (obs): waves executed + anomalous gain, seeded
+    # with the root search's gain — everything below reads values the
+    # wave already computed, so no new sweeps or collectives. Anomalous
+    # means NaN or +inf: K_MIN_SCORE (-inf) is the legitimate "no valid
+    # split" sentinel and must not flag.
+    health0 = None
+    if params.obs_health:
+        health0 = jnp.stack([
+            jnp.float32(0.0),
+            jnp.any(_gain_anomaly(best0.gain)).astype(jnp.float32)])
     state = _FrontierState(
         leaf_id=leaf_id0, hist_pool=hist_pool, best=best, tree=tree,
         leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
-        leaf_max=jnp.full((l,), jnp.inf, jnp.float32))
+        leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
+        health=health0)
 
     def cond_fn(s: _FrontierState) -> jnp.ndarray:
         return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
@@ -252,9 +276,20 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
         best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
 
+        health = s.health
+        if health is not None:
+            # committed lanes must be finite (NaN/-inf never pass
+            # gval > 0, +inf does); child searches may only return real
+            # gains or the -inf sentinel
+            bad_gain = jnp.any(~jnp.isfinite(gval) & valid) | \
+                jnp.any(_gain_anomaly(b2k.gain))
+            health = jnp.stack([health[0] + 1.0,
+                                jnp.maximum(health[1],
+                                            bad_gain.astype(jnp.float32))])
+
         return _FrontierState(leaf_id=leaf_id, hist_pool=pool, best=best,
                               tree=tree, leaf_min=leaf_min,
-                              leaf_max=leaf_max)
+                              leaf_max=leaf_max, health=health)
 
     ladder = wave_width_ladder(l, params.max_depth)  # pow-2 widths, <= kb
     if params.frontier_bucketing and len(ladder) > 1:
@@ -279,4 +314,4 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
             return wave_step(s, kb)
 
     state = lax.while_loop(cond_fn, step, state)
-    return state.tree, state.leaf_id, None
+    return state.tree, state.leaf_id, state.health

@@ -183,6 +183,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_score[dataset_name][eval_name] = score
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration
+    obs = getattr(booster._impl, "obs", None)
+    if obs is not None and obs.enabled:
+        # flush the event stream / close any open Perfetto window; the
+        # stats endpoint stays up for post-train scrapes
+        obs.finish()
     return booster
 
 

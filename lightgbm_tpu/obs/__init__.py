@@ -1,0 +1,33 @@
+"""lightgbm_tpu.obs — unified runtime telemetry.
+
+One low-overhead observability layer shared by training, checkpointing and
+serving:
+
+- ``registry``: a process-wide, thread-safe counter/gauge/summary registry
+  with Prometheus text exposition and JSON snapshots.  serving/metrics.py
+  and profiling.py's compile-cache counters are both backed by it.
+- ``trace``: host-side span timers (device sync only at span close), a
+  JSON-lines event stream, and an on-demand ``jax.profiler`` Perfetto
+  capture helper for a configurable iteration window.
+- ``health``: host dispatch for device-side health flags (non-finite
+  grad/hess, zero-positive-gain waves) that the training step piggy-backs
+  on existing reductions — warn, checkpoint-and-abort, or raise.
+- ``server``: an optional lightweight stats HTTP endpoint during training
+  (Prometheus text + JSON snapshot + healthz).
+- ``runtime``: ``TrainingObs``, the per-booster facade built from the
+  ``observability=none|basic|full`` config knob that the boosting loop
+  drives.
+
+Everything is off by default (``observability=none``) and the instrumented
+code paths collapse to no-ops so the training loop's compiled program is
+byte-identical when telemetry is disabled.
+"""
+from .health import (HEALTH_NONFINITE, HEALTH_NONFINITE_GAIN,  # noqa: F401
+                     HEALTH_STUMP, HEALTH_VEC_LEN, HEALTH_WAVES,
+                     HealthMonitor, HealthReport, health_vec)
+from .registry import (Counter, Gauge, MetricsRegistry,  # noqa: F401
+                       Summary, get_registry)
+from .runtime import TrainingObs, resolve_health_action  # noqa: F401
+from .server import StatsServer  # noqa: F401
+from .trace import (EventStream, Tracer, perfetto_trace,  # noqa: F401
+                    span)

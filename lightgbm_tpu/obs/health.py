@@ -1,0 +1,146 @@
+"""Training health: device-side flag vector + host-side dispatch.
+
+The device side is ``health_vec`` — a fixed-length f32 vector the jitted
+training step computes from values it ALREADY has in registers:
+
+- non-finite grad/hess: ``sum(g) + sum(h)`` is two cheap reductions over
+  arrays the histogram sweep is about to read anyway; any NaN/Inf in
+  either tensor poisons the scalar (NaN survives masking because
+  ``NaN * 0 == NaN``), so one isfinite on the sum catches a single bad
+  row.  No new dataset sweeps.
+- zero-positive-gain wave ("stump"): reuses the grower's ``any_split``
+  scalar — the iteration produced a tree with no split.
+- frontier gain health: the wave loop piggy-backs a 2-scalar accumulator
+  (waves executed, non-finite committed gain) on state it already
+  carries; gains derive from the per-wave psum'd histograms, so the
+  per-wave collective count is UNCHANGED (tests/test_obs.py pins this).
+
+The host side is ``HealthMonitor``: it inspects the fetched vectors once
+per dispatch (per iteration, or per fused block) and dispatches the
+configured action — ``warn`` (log + count), ``abort``
+(checkpoint-then-raise) or ``raise``.  Stump iterations are counted and
+logged but never escalate: a converged model legitimately stops
+splitting, while non-finite values never legitimately appear.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..log import LightGBMError, Log
+from .registry import MetricsRegistry, get_registry
+
+# layout of the device health vector (f32[HEALTH_VEC_LEN] per iteration)
+HEALTH_NONFINITE = 0        # 1.0 when grad/hess contain NaN/Inf
+HEALTH_STUMP = 1            # 1.0 when the iteration grew no split
+HEALTH_NONFINITE_GAIN = 2   # 1.0 when a committed frontier gain was NaN/Inf
+HEALTH_WAVES = 3            # frontier waves executed (sum over trees)
+HEALTH_VEC_LEN = 4
+
+_ACTIONS = ("none", "warn", "abort", "raise")
+
+
+def health_vec(grad, hess, any_split, grower_health=None):
+    """Build the device health vector inside the jitted training step.
+
+    ``grower_health``: optional f32[K, 2] per-class-tree (waves,
+    nonfinite_gain) from the frontier grower, or None when the grower
+    does not report (exact mode, mesh path)."""
+    import jax.numpy as jnp
+
+    total = jnp.sum(grad) + jnp.sum(hess)
+    nonfinite = (~jnp.isfinite(total)).astype(jnp.float32)
+    stump = (~any_split).astype(jnp.float32)
+    if grower_health is None:
+        waves = jnp.float32(0.0)
+        bad_gain = jnp.float32(0.0)
+    else:
+        waves = jnp.sum(grower_health[..., 0])
+        bad_gain = jnp.max(grower_health[..., 1])
+    return jnp.stack([nonfinite, stump, bad_gain, waves])
+
+
+class HealthReport:
+    """One detected anomaly (or stump note) at a concrete iteration."""
+
+    __slots__ = ("iteration", "kind", "message")
+
+    def __init__(self, iteration: int, kind: str, message: str):
+        self.iteration = iteration
+        self.kind = kind
+        self.message = message
+
+    def __repr__(self):
+        return "HealthReport(iter=%d, kind=%r)" % (self.iteration, self.kind)
+
+
+class HealthMonitor:
+    """Host-side inspector for fetched health vectors."""
+
+    def __init__(self, action: str = "warn",
+                 registry: Optional[MetricsRegistry] = None,
+                 events=None, on_abort=None):
+        if action not in _ACTIONS:
+            raise LightGBMError("unknown health_monitor action %r "
+                                "(expected one of %s)"
+                                % (action, "/".join(_ACTIONS)))
+        self.action = action
+        self.reports: List[HealthReport] = []
+        self._events = events
+        self._on_abort = on_abort
+        reg = registry if registry is not None else get_registry()
+        self._c_anomaly = reg.counter(
+            "lgbm_train_health_anomalies_total",
+            "Non-finite grad/hess or gain anomalies detected in training.")
+        self._c_stump = reg.counter(
+            "lgbm_train_stump_iterations_total",
+            "Iterations that grew a tree with no split.")
+        self._g_waves = reg.gauge(
+            "lgbm_train_frontier_waves_last",
+            "Frontier waves executed by the most recent iteration.")
+
+    def anomaly_count(self) -> int:
+        return int(self._c_anomaly.value)
+
+    def check(self, health_rows, start_iter: int, booster=None
+              ) -> List[HealthReport]:
+        """Inspect fetched vectors (``[B, HEALTH_VEC_LEN]`` host floats for
+        iterations ``start_iter..start_iter+B-1``) and dispatch the
+        configured action.  Raises from inside when the action demands."""
+        new: List[HealthReport] = []
+        for off, row in enumerate(health_rows):
+            it = start_iter + off
+            self._g_waves.set(float(row[HEALTH_WAVES]))
+            if row[HEALTH_STUMP] > 0:
+                self._c_stump.inc()
+                new.append(HealthReport(
+                    it, "zero_gain_wave",
+                    "iteration %d grew no split (all gains <= 0)" % it))
+            if row[HEALTH_NONFINITE] > 0:
+                new.append(HealthReport(
+                    it, "nonfinite_gradient",
+                    "non-finite gradient/hessian at iteration %d" % it))
+            if row[HEALTH_NONFINITE_GAIN] > 0:
+                new.append(HealthReport(
+                    it, "nonfinite_gain",
+                    "non-finite split gain committed at iteration %d" % it))
+        self.reports.extend(new)
+        anomalies = [r for r in new if r.kind != "zero_gain_wave"]
+        for r in new:
+            if self._events is not None:
+                self._events.write("health", iteration=r.iteration,
+                                   kind=r.kind, message=r.message)
+            if r.kind == "zero_gain_wave":
+                Log.debug("health: %s" % r.message)
+            else:
+                self._c_anomaly.inc()
+                Log.warning("health: %s" % r.message)
+        if anomalies and self.action in ("abort", "raise"):
+            first = anomalies[0]
+            if self.action == "abort" and self._on_abort is not None:
+                try:
+                    self._on_abort(booster, first)
+                except Exception as e:
+                    Log.warning("health abort checkpoint failed: %s" % e)
+            raise LightGBMError(
+                "training aborted by health monitor: %s" % first.message)
+        return new

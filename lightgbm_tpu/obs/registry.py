@@ -1,0 +1,272 @@
+"""Process-wide metrics registry: counters, gauges, bounded summaries.
+
+One registry serves the whole process — training spans, health monitors,
+compile-cache accounting and the serving path all register here, so a
+single Prometheus scrape (serving ``/metrics/prometheus`` or the training
+stats endpoint) sees everything.  Metrics are keyed by ``(name, labels)``
+and get-or-create is idempotent: calling ``counter("x")`` twice returns
+the same object, which is what lets serving/metrics.py and profiling.py
+share series without import-order coupling.
+
+Thread safety: the registry map has its own lock and every metric guards
+its state with one; all mutators are O(1) (summaries append to a bounded
+deque), so hot paths never contend on a global lock.
+
+This module deliberately imports no jax/numpy at module scope — the
+serving server and the stats endpoint must be importable in processes
+that never touch a device.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: integral floats print as integers so the
+    exposition (and the golden test pinning it) stays stable."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _label_suffix(labels: Tuple[Tuple[str, str], ...],
+                  extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+    items = tuple(labels) + tuple(extra)
+    if not items:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _escape_label(v))
+                             for k, v in items)
+
+
+class _Metric:
+    """Shared plumbing: identity, lock, label set."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 labels: Tuple[Tuple[str, str], ...]):
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self._lock = threading.Lock()
+
+    @property
+    def label_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+
+class Counter(_Metric):
+    """Monotonic counter.  ``inc`` only; negative increments are clamped."""
+
+    kind = "counter"
+
+    def __init__(self, name, help, labels):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            return
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def samples(self):
+        return [(self.name, self.labels, self.value)]
+
+
+class Gauge(_Metric):
+    """Settable instantaneous value."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help, labels):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def samples(self):
+        return [(self.name, self.labels, self.value)]
+
+
+class Summary(_Metric):
+    """Bounded-window distribution exposed as a Prometheus summary:
+    ``{quantile="..."}`` series over the last ``window`` observations plus
+    lifetime ``_sum`` / ``_count``.  A windowed summary is the right tool
+    for serving latency (and span durations) — it answers "p99 lately",
+    not "p99 since process start"."""
+
+    kind = "summary"
+
+    def __init__(self, name, help, labels, window: int = 4096):
+        super().__init__(name, help, labels)
+        self._window = collections.deque(maxlen=window)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            v = float(value)
+            self._window.append(v)
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def values(self) -> List[float]:
+        """Copy of the current observation window (oldest first)."""
+        with self._lock:
+            return list(self._window)
+
+    def quantiles(self) -> Dict[float, float]:
+        with self._lock:
+            data = sorted(self._window)
+        if not data:
+            return {q: 0.0 for q in _QUANTILES}
+        out = {}
+        for q in _QUANTILES:
+            # nearest-rank on the sorted window; matches latency_summary's
+            # numpy percentile to within one sample
+            idx = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
+            out[q] = data[idx]
+        return out
+
+    def samples(self):
+        qs = self.quantiles()
+        with self._lock:
+            s, c = self._sum, self._count
+        rows = [(self.name, self.labels + (("quantile", "%g" % q),), qs[q])
+                for q in _QUANTILES]
+        rows.append((self.name + "_sum", self.labels, s))
+        rows.append((self.name + "_count", self.labels, c))
+        return rows
+
+
+class MetricsRegistry:
+    """Get-or-create registry over ``(name, labels)`` keyed metrics."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "summary": Summary}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple, _Metric] = {}
+        self._help: Dict[str, str] = {}
+
+    def _get(self, kind: str, name: str, help: str,
+             labels: Optional[Dict[str, str]], **kw) -> _Metric:
+        lbl = tuple(sorted((str(k), str(v))
+                           for k, v in (labels or {}).items()))
+        key = (name, lbl)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._KINDS[kind](name, help, lbl, **kw)
+                self._metrics[key] = m
+                self._help.setdefault(name, help)
+            elif m.kind != kind:
+                raise ValueError("metric %r already registered as %s, "
+                                 "requested %s" % (name, m.kind, kind))
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get("counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get("gauge", name, help, labels)
+
+    def summary(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None,
+                window: int = 4096) -> Summary:
+        return self._get("summary", name, help, labels, window=window)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # ------------------------------------------------------------ export
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4.  Families sorted by
+        name, series by label string — the output is deterministic for a
+        given registry state (the golden test pins it)."""
+        families: Dict[str, List[_Metric]] = {}
+        for m in self.metrics():
+            families.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(families):
+            group = families[name]
+            help_txt = self._help.get(name, "")
+            if help_txt:
+                lines.append("# HELP %s %s"
+                             % (name, help_txt.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (name, group[0].kind))
+            rows = []
+            for m in sorted(group, key=lambda m: m.labels):
+                rows.extend(m.samples())
+            for sample_name, labels, value in rows:
+                lines.append("%s%s %s" % (sample_name, _label_suffix(labels),
+                                          _fmt_value(value)))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> Dict:
+        """Flat JSON view: ``name{k="v"}`` -> value (summaries expand to
+        quantile/sum/count keys)."""
+        out: Dict[str, float] = {}
+        for m in self.metrics():
+            for sample_name, labels, value in m.samples():
+                out[sample_name + _label_suffix(labels)] = value
+        return {"ts": round(time.time(), 3), "metrics": out}
+
+    def write_jsonl(self, path_or_fh) -> Dict:
+        """Append one snapshot as a JSON line; returns the snapshot."""
+        snap = self.snapshot()
+        line = json.dumps(snap, sort_keys=True) + "\n"
+        if hasattr(path_or_fh, "write"):
+            path_or_fh.write(line)
+            path_or_fh.flush()
+        else:
+            with open(path_or_fh, "a") as fh:
+                fh.write(line)
+        return snap
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem shares."""
+    return _REGISTRY

@@ -1,0 +1,106 @@
+"""Lightweight stats HTTP endpoint for training-time scraping.
+
+A daemon-threaded ``ThreadingHTTPServer`` that exposes the process-wide
+metrics registry while a training run is live:
+
+- ``GET /metrics``  -> Prometheus text exposition (0.0.4)
+- ``GET /stats``    -> JSON snapshot of every registered series
+- ``GET /healthz``  -> ``{"status": "ok"|"anomalous", "anomalies": N}``
+
+Enabled via ``obs_stats_port`` (>= 0; 0 binds an OS-assigned port whose
+number is exported in ``StatsServer.port`` and logged).  The server binds
+127.0.0.1 only — it is a diagnostics tap, not a service surface — and
+shares nothing mutable with the training loop beyond the thread-safe
+registry, so scrapes never block an iteration.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..log import Log
+from .registry import MetricsRegistry, get_registry
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lgbm-obs/0.1"
+
+    # class attributes bound by StatsServer.start()
+    registry: MetricsRegistry = None
+    anomaly_counter = None
+
+    def log_message(self, fmt, *args):  # quiet: route through our logger
+        Log.debug("obs.server: " + fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        try:
+            if self.path == "/metrics":
+                body = self.registry.prometheus_text().encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/stats":
+                body = json.dumps(self.registry.snapshot(),
+                                  sort_keys=True).encode()
+                self._send(200, body, "application/json")
+            elif self.path == "/healthz":
+                n = (int(self.anomaly_counter.value)
+                     if self.anomaly_counter is not None else 0)
+                body = json.dumps({
+                    "status": "ok" if n == 0 else "anomalous",
+                    "anomalies": n,
+                }).encode()
+                self._send(200, body, "application/json")
+            else:
+                self._send(404, b'{"error": "not found"}',
+                           "application/json")
+        except Exception as e:  # never kill the scrape thread
+            try:
+                self._send(500, json.dumps({"error": str(e)}).encode(),
+                           "application/json")
+            except Exception:
+                pass
+
+
+class StatsServer:
+    """Own one bound socket + serving thread; ``stop()`` is idempotent."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        self._registry = registry if registry is not None else get_registry()
+        handler = type("BoundStatsHandler", (_Handler,), {
+            "registry": self._registry,
+            "anomaly_counter": self._registry.counter(
+                "lgbm_train_health_anomalies_total",
+                "Non-finite grad/hess or gain anomalies detected in "
+                "training."),
+        })
+        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StatsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="lgbm-obs-stats", daemon=True)
+        self._thread.start()
+        Log.info("obs: stats endpoint on http://%s:%d (metrics/stats/"
+                 "healthz)" % (self.host, self.port))
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()

@@ -1,0 +1,203 @@
+"""Spans, event streams and Perfetto capture for the real training loop.
+
+The span API is host-side: a ``with tracer.span("hist_build")`` block
+times wall clock and only touches the device at span CLOSE, where it can
+``block_until_ready`` the arrays handed to it — one sync per span, never
+per op, so the async dispatch pipeline inside a span stays intact.  When
+tracing is disabled the span object is a shared no-op constant and the
+``with`` costs two trivial method calls.
+
+Events are JSON-lines (one object per line, ``ts`` + ``event`` keys
+always present), append-only and flushed per write so a preempted run
+keeps everything it logged.
+
+Perfetto capture rides ``jax.profiler.start_trace/stop_trace``; the
+trace lands under ``<dir>/plugins/profile/...`` and loads in
+ui.perfetto.dev or TensorBoard.  Capture is process-global in jax, so
+the helper refuses to nest instead of crashing mid-train.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from ..log import Log
+from .registry import MetricsRegistry, get_registry
+
+
+class EventStream:
+    """Thread-safe JSON-lines sink (a file path or an open handle)."""
+
+    def __init__(self, path_or_fh):
+        self._lock = threading.Lock()
+        if hasattr(path_or_fh, "write"):
+            self._fh = path_or_fh
+            self._owns = False
+        else:
+            self._fh = open(path_or_fh, "a")
+            self._owns = True
+
+    def write(self, event: str, **fields) -> Dict:
+        rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, sort_keys=True, default=str) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._owns:
+                self._fh.close()
+
+
+class _NullSpan:
+    """Disabled span: shared constant, ~free to enter/exit."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    def __init__(self, tracer: "Tracer", name: str, sync, fields: Dict):
+        self._tracer = tracer
+        self.name = name
+        self._sync = sync
+        self._fields = fields
+        self.duration_s = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sync is not None:
+            try:
+                import jax
+                jax.block_until_ready(self._sync)
+            except Exception:
+                pass
+        self.duration_s = time.perf_counter() - self._t0
+        self._tracer._close(self, failed=exc_type is not None)
+        return False
+
+
+class Tracer:
+    """Span factory bound to a registry summary + optional event stream."""
+
+    def __init__(self, enabled: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 events: Optional[EventStream] = None,
+                 metric: str = "lgbm_span_seconds"):
+        self.enabled = enabled
+        self._registry = registry if registry is not None else get_registry()
+        self.events = events
+        self._metric = metric
+
+    def span(self, name: str, sync=None, **fields):
+        """Open a timed span.  ``sync``: arrays to ``block_until_ready``
+        at close (ONE sync point); extra ``fields`` land on the event."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, sync, fields)
+
+    def _close(self, s: _Span, failed: bool) -> None:
+        self._registry.summary(
+            self._metric, "Wall-clock span durations.",
+            labels={"span": s.name}).observe(s.duration_s)
+        if self.events is not None:
+            self.events.write("span", span=s.name,
+                              dur_s=round(s.duration_s, 6),
+                              failed=failed, **s._fields)
+
+
+def span(name: str, sync=None, **fields):
+    """Module-level convenience: an always-on span against the global
+    registry (no event stream).  Library code should prefer a
+    ``TrainingObs``-owned tracer, which respects ``observability=none``."""
+    return Tracer(enabled=True).span(name, sync=sync, **fields)
+
+
+# ------------------------------------------------------------ perfetto
+_trace_lock = threading.Lock()
+_trace_active = False
+
+
+@contextlib.contextmanager
+def perfetto_trace(trace_dir: Optional[str]):
+    """Capture a ``jax.profiler`` trace into ``trace_dir`` for the body of
+    the ``with``.  ``trace_dir`` falsy -> no-op.  Nested/concurrent
+    captures degrade to a warning (jax's profiler is process-global).
+    Yields True when a capture actually started."""
+    global _trace_active
+    if not trace_dir:
+        yield False
+        return
+    with _trace_lock:
+        if _trace_active:
+            Log.warning("perfetto capture already active; skipping nested "
+                        "capture into %s" % trace_dir)
+            start = False
+        else:
+            _trace_active = True
+            start = True
+    if not start:
+        yield False
+        return
+    started = False
+    try:
+        import jax
+        try:
+            jax.profiler.start_trace(trace_dir)
+            started = True
+        except Exception as e:  # profiler backend unavailable: degrade
+            Log.warning("jax.profiler.start_trace failed (%s); continuing "
+                        "without Perfetto capture" % e)
+        yield started
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                Log.warning("jax.profiler.stop_trace failed: %s" % e)
+        with _trace_lock:
+            _trace_active = False
+
+
+class PerfettoWindow:
+    """Drive ``perfetto_trace`` over a [start, start+count) iteration
+    window from inside the boosting loop.  ``step(lo, hi)`` is called
+    before each dispatch covering iterations [lo, hi); capture starts
+    when the window first overlaps and stops once ``hi`` passes the end
+    (fused blocks widen the capture to block granularity)."""
+
+    def __init__(self, trace_dir: str, start_iter: int, num_iters: int):
+        self.trace_dir = trace_dir
+        self.lo = int(start_iter)
+        self.hi = int(start_iter) + int(num_iters)
+        self._cm = None
+        self.captured = False
+
+    def step(self, lo: int, hi: int) -> None:
+        if self._cm is None and lo < self.hi and hi > self.lo:
+            self._cm = perfetto_trace(self.trace_dir)
+            self.captured = bool(self._cm.__enter__())
+        elif self._cm is not None and lo >= self.hi:
+            self.close()
+
+    def close(self) -> None:
+        if self._cm is not None:
+            cm, self._cm = self._cm, None
+            cm.__exit__(None, None, None)

@@ -32,24 +32,35 @@ _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 _counts_lock = threading.Lock()
-_counts = {"backend_compiles": 0, "persistent_cache_hits": 0,
-           "persistent_cache_misses": 0}
 _hooks_installed = False
+
+# the counters themselves live on the process-wide obs registry
+# (lightgbm_tpu/obs/registry.py) so one Prometheus scrape sees them next
+# to serving/training series; this module keeps its historical API as a
+# thin shim over those series
+from .obs.registry import get_registry  # noqa: E402
+
+_c_backend = get_registry().counter(
+    "lgbm_jax_backend_compiles_total",
+    "XLA backend compilations observed via jax.monitoring.")
+_c_cache_hit = get_registry().counter(
+    "lgbm_jax_compile_cache_hits_total",
+    "Persistent compilation-cache hits.")
+_c_cache_miss = get_registry().counter(
+    "lgbm_jax_compile_cache_misses_total",
+    "Persistent compilation-cache misses.")
 
 
 def _on_event_duration(event: str, duration: float, **kwargs) -> None:
     if event == _BACKEND_COMPILE_EVENT:
-        with _counts_lock:
-            _counts["backend_compiles"] += 1
+        _c_backend.inc()
 
 
 def _on_event(event: str, **kwargs) -> None:
     if event == _CACHE_HIT_EVENT:
-        with _counts_lock:
-            _counts["persistent_cache_hits"] += 1
+        _c_cache_hit.inc()
     elif event == _CACHE_MISS_EVENT:
-        with _counts_lock:
-            _counts["persistent_cache_misses"] += 1
+        _c_cache_miss.inc()
 
 
 def install_compile_hook() -> None:
@@ -66,16 +77,16 @@ def install_compile_hook() -> None:
 
 def backend_compile_count() -> int:
     """XLA backend compilations observed since the hook was installed."""
-    with _counts_lock:
-        return _counts["backend_compiles"]
+    return int(_c_backend.value)
 
 
 def compile_cache_stats() -> Dict[str, int]:
     """Snapshot of the compile counters (installs the hooks first, so the
     first caller anchors counting at zero)."""
     install_compile_hook()
-    with _counts_lock:
-        return dict(_counts)
+    return {"backend_compiles": int(_c_backend.value),
+            "persistent_cache_hits": int(_c_cache_hit.value),
+            "persistent_cache_misses": int(_c_cache_miss.value)}
 
 
 def enable_compile_cache(cache_dir: str) -> bool:
@@ -138,15 +149,18 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
                                  sort_placement_profitable, stack_vals)
     from .core.split import find_best_split
 
+    from .obs.trace import perfetto_trace
+
     xb = booster.xb
     n = booster.num_data
     params = booster.grow_params
     meta = booster.feature_meta
     out: Dict[str, float] = {}
 
-    if trace_dir:
-        jax.profiler.start_trace(trace_dir)
-    try:
+    # trace_dir rides the shared Perfetto helper (obs/trace.py), which
+    # degrades to a warning when the profiler backend is unavailable or a
+    # capture is already active instead of crashing the probe
+    with perfetto_trace(trace_dir):
         scores = booster.scores
         if booster.objective is not None:
             obj = booster.objective
@@ -279,9 +293,6 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
         # snapshot save + restore on the booster's real model/shapes, so
         # the per-period cost shows up next to the phases it competes with
         out.update(_checkpoint_probe(booster))
-    finally:
-        if trace_dir:
-            jax.profiler.stop_trace()
     return {k: round(v, 5) for k, v in out.items()}
 
 

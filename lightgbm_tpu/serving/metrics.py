@@ -1,5 +1,13 @@
 """Serving metrics: latency quantiles, queue depth, cache and compile counts.
 
+Backed by the process-wide observability registry
+(``lightgbm_tpu.obs.registry``): every ``ServingMetrics`` instance owns a
+labelled slice (``sink="serving-N"``) of shared ``lgbm_serving_*`` series,
+so the Prometheus exposition (serving ``/metrics/prometheus``, training
+stats endpoint) and this class's JSON snapshots read the SAME counters —
+no second bookkeeping path.  The public API and snapshot schema are
+unchanged from the pre-registry version (docs/Serving.md).
+
 Two sources of truth for "did we recompile":
 
 - the predictor cache's own miss counter (every miss creates + compiles a
@@ -16,16 +24,20 @@ the schema documented in docs/Serving.md.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict
 
+from ..obs.registry import get_registry
 # the hook itself lives in profiling (training's zero-recompile invariant
 # and the persistent-cache counters share it); re-exported here because
 # serving callers (serve_smoke, tests) learned these names first
 from ..profiling import (backend_compile_count,  # noqa: F401
                          install_compile_hook, latency_summary)
+
+_sink_seq = itertools.count()
 
 
 class ServingMetrics:
@@ -34,45 +46,89 @@ class ServingMetrics:
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
         self._t0 = time.time()
-        self.requests = 0
-        self.rows = 0
-        self.batches = 0                 # padded forward passes dispatched
-        self.cache_hits = 0
-        self.cache_misses = 0            # == predictor compiles requested
-        self.errors = 0
-        self.queue_depth = 0             # gauge, updated by the batch queue
-        self._latency_ms = collections.deque(maxlen=window)
+        reg = get_registry()
+        # per-instance label: each engine/test gets independent series
+        # while one scrape of the global registry still sees them all
+        lbl = {"sink": "serving-%d" % next(_sink_seq)}
+        self._c_requests = reg.counter(
+            "lgbm_serving_requests_total", "Prediction requests served.",
+            labels=lbl)
+        self._c_rows = reg.counter(
+            "lgbm_serving_rows_total", "Prediction rows served.", labels=lbl)
+        self._c_batches = reg.counter(
+            "lgbm_serving_batches_total",
+            "Padded forward passes dispatched.", labels=lbl)
+        self._c_cache_hits = reg.counter(
+            "lgbm_serving_predictor_cache_hits_total",
+            "Compiled-predictor cache hits.", labels=lbl)
+        self._c_cache_misses = reg.counter(
+            "lgbm_serving_predictor_cache_misses_total",
+            "Compiled-predictor cache misses (== compiles requested).",
+            labels=lbl)
+        self._c_errors = reg.counter(
+            "lgbm_serving_errors_total", "Failed requests.", labels=lbl)
+        self._g_queue = reg.gauge(
+            "lgbm_serving_queue_depth",
+            "Micro-batch queue depth (gauge, set by the batch queue).",
+            labels=lbl)
+        self._s_latency = reg.summary(
+            "lgbm_serving_request_latency_ms",
+            "Request latency (milliseconds, queue-inclusive for batched "
+            "callers).", labels=lbl, window=window)
         self._batch_rows = collections.deque(maxlen=window)
         self._compile_floor = 0          # backend compiles at warmup end
         self._miss_floor = 0             # cache misses at warmup end
         install_compile_hook()
 
+    # ------------------------------------------------------------ views
+    # historical attribute API, now reading the registry-backed series
+    @property
+    def requests(self) -> int:
+        return int(self._c_requests.value)
+
+    @property
+    def rows(self) -> int:
+        return int(self._c_rows.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._c_batches.value)
+
+    @property
+    def cache_hits(self) -> int:
+        return int(self._c_cache_hits.value)
+
+    @property
+    def cache_misses(self) -> int:
+        return int(self._c_cache_misses.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._c_errors.value)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._g_queue.value)
+
     # ------------------------------------------------------------ recording
     def record_request(self, rows: int, latency_s: float) -> None:
-        with self._lock:
-            self.requests += 1
-            self.rows += rows
-            self._latency_ms.append(latency_s * 1000.0)
+        self._c_requests.inc()
+        self._c_rows.inc(rows)
+        self._s_latency.observe(latency_s * 1000.0)
 
     def record_batch(self, rows: int) -> None:
+        self._c_batches.inc()
         with self._lock:
-            self.batches += 1
             self._batch_rows.append(rows)
 
     def record_cache(self, hit: bool) -> None:
-        with self._lock:
-            if hit:
-                self.cache_hits += 1
-            else:
-                self.cache_misses += 1
+        (self._c_cache_hits if hit else self._c_cache_misses).inc()
 
     def record_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._c_errors.inc()
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = depth
+        self._g_queue.set(depth)
 
     def mark_warmup_done(self) -> None:
         """Anchor the recompile counter: compiles past this point are
@@ -92,7 +148,7 @@ class ServingMetrics:
     # ------------------------------------------------------------ export
     def snapshot(self) -> Dict:
         with self._lock:
-            lat = latency_summary(self._latency_ms)
+            lat = latency_summary(self._s_latency.values())
             rows_per_batch = (float(sum(self._batch_rows))
                               / max(len(self._batch_rows), 1))
             return {

@@ -11,6 +11,8 @@ HTTP API:
                    "raw_score": false, "num_iteration": null}
                   -> {"model": ..., "rows": N, "predictions": [...]}
   GET  /metrics   one ServingMetrics snapshot (docs/Serving.md schema)
+  GET  /metrics/prometheus   process-wide obs registry, Prometheus text
+                  exposition 0.0.4 (serving + compile + training series)
   GET  /healthz   {"status": "ok", "models": [...]}
   GET  /models    registered model ids + shapes
 
@@ -30,6 +32,7 @@ import numpy as np
 
 from ..config import Config
 from ..log import Log, LightGBMError
+from ..obs.registry import get_registry
 from .batching import MicroBatchQueue
 from .metrics import ServingMetrics
 from .predictor import ServingEngine, bucket_sizes
@@ -88,9 +91,12 @@ class _Handler(BaseHTTPRequestHandler):
         Log.debug("serve: " + fmt, *args)
 
     def _reply(self, code: int, payload: Dict) -> None:
-        body = json.dumps(payload).encode("utf-8")
+        self._reply_raw(code, json.dumps(payload).encode("utf-8"),
+                        "application/json")
+
+    def _reply_raw(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -101,6 +107,11 @@ class _Handler(BaseHTTPRequestHandler):
                               "models": self.app.engine.registry.ids()})
         elif self.path == "/metrics":
             self._reply(200, self.app.engine.metrics.snapshot())
+        elif self.path == "/metrics/prometheus":
+            # the whole process' registry, not just this engine's slice —
+            # a scrape sees serving, compile-cache and training series
+            self._reply_raw(200, get_registry().prometheus_text().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/models":
             self._reply(200, self.app.handle_models())
         else:

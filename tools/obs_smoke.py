@@ -1,0 +1,137 @@
+"""Observability end-to-end smoke (tier1 CI).
+
+Trains a small binary model for a few iterations with the full telemetry
+stack on — ``observability=full``, health monitor warning, the JSON-lines
+event stream, the in-process stats HTTP endpoint, and a 1-iteration
+Perfetto capture window — then verifies the whole pipe from the outside:
+
+- scrapes ``/metrics`` (Prometheus text), ``/stats`` (JSON snapshot) and
+  ``/healthz`` over HTTP and asserts the iteration counter matches;
+- asserts ZERO health anomalies on the healthy run (warn mode must stay
+  silent when nothing is wrong);
+- asserts the event stream carries one event per iteration plus the
+  ``train_done`` record;
+- reports (but does not require) the Perfetto trace artifacts — the
+  capture helper degrades gracefully where the profiler is unavailable.
+
+Exit code 0 = every assertion holds. The summary JSON goes to ``--out``
+(and stdout); the event stream and any trace land under ``--workdir`` so
+CI can upload them as artifacts.
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # repo root for lightgbm_tpu
+
+
+def _scrape(port: int, path: str) -> bytes:
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=10) as r:
+        return r.read()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default="obs_smoke_out",
+                    help="event stream + perfetto artifacts land here")
+    ap.add_argument("--out", default="", help="write the summary JSON here")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+    os.makedirs(args.workdir, exist_ok=True)
+    event_file = os.path.join(args.workdir, "events.jsonl")
+    trace_dir = os.path.join(args.workdir, "perfetto")
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import engine
+
+    r = np.random.RandomState(0)
+    n, f = 3000, 8
+    X = r.randn(n, f).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * r.randn(n)) > 0) \
+        .astype(np.float32)
+
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tree_growth": "frontier",
+              "observability": "full",
+              "health_monitor": "warn",
+              "obs_event_file": event_file,
+              "obs_stats_port": 0,            # ephemeral; read back below
+              "obs_perfetto_dir": trace_dir,
+              "obs_perfetto_start": 1,
+              "obs_perfetto_iters": 1}
+    bst = engine.train(params, lgb.Dataset(X, label=y),
+                       num_boost_round=args.iters)
+
+    obs = bst._impl.obs
+    failures = []
+
+    def check(cond, msg):
+        (failures.append(msg) if not cond else None)
+        print("%s %s" % ("ok  " if cond else "FAIL", msg))
+
+    # ---- health: a clean run must report zero anomalies ----------------
+    mon = obs.monitor
+    check(mon is not None and mon.action == "warn",
+          "health monitor armed in warn mode")
+    anomalies = mon.anomaly_count() if mon is not None else -1
+    check(anomalies == 0, "zero health anomalies (got %d)" % anomalies)
+
+    # ---- event stream --------------------------------------------------
+    with open(event_file) as fh:
+        events = [json.loads(line) for line in fh if line.strip()]
+    kinds = [e.get("event") for e in events]
+    iters = [e for e in events if e.get("event") == "iteration"]
+    check(len(iters) >= args.iters,
+          ">= %d iteration events (got %d)" % (args.iters, len(iters)))
+    done = [e for e in events if e.get("event") == "train_done"]
+    check(len(done) == 1 and done[0].get("iterations") == args.iters,
+          "train_done event with iterations=%d" % args.iters)
+    check(not done or done[0].get("anomalies") == 0,
+          "train_done reports zero anomalies")
+
+    # ---- HTTP scrape (the stats server outlives training) --------------
+    check(obs.stats is not None, "stats endpoint bound")
+    scraped = {}
+    if obs.stats is not None:
+        port = obs.stats.port
+        prom = _scrape(port, "/metrics").decode()
+        check("lgbm_train_iterations_total %d" % args.iters in prom,
+              "/metrics exposes lgbm_train_iterations_total")
+        check("lgbm_train_iteration_seconds" in prom,
+              "/metrics exposes the iteration-time summary")
+        snap = json.loads(_scrape(port, "/stats"))
+        check("metrics" in snap and "ts" in snap, "/stats snapshot parses")
+        hz = json.loads(_scrape(port, "/healthz"))
+        check(hz.get("status") == "ok" and hz.get("anomalies") == 0,
+              "/healthz reports ok with zero anomalies")
+        scraped = {"port": port, "healthz": hz,
+                   "prom_lines": len(prom.splitlines())}
+        obs.stats.stop()
+
+    # Perfetto artifacts are best-effort: report what landed
+    trace_files = []
+    for root, _dirs, files in os.walk(trace_dir):
+        trace_files += [os.path.relpath(os.path.join(root, fn), trace_dir)
+                        for fn in files]
+    print("perfetto artifacts: %d file(s)" % len(trace_files))
+
+    summary = {"iterations": args.iters, "anomalies": anomalies,
+               "event_kinds": sorted(set(k for k in kinds if k)),
+               "events": len(events), "scrape": scraped,
+               "perfetto_files": len(trace_files),
+               "failures": failures}
+    blob = json.dumps(summary, indent=2, sort_keys=True)
+    print(blob)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(blob + "\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
