@@ -293,17 +293,26 @@ def run_bench(backend_info: dict) -> dict:
             eng = ServingEngine(max_batch=int(
                 os.environ.get("BENCH_SERVE_BATCH", 4096)))
             eng.registry.register_impl("bench", b)
-            eng.warmup(raw_scores=(True,))
+            # extract_costs: per-bucket predict_b<N> XLA costs land on the
+            # cost model for the roofline table below (before the
+            # recompile floor is marked, so they never trip the invariant)
+            eng.warmup(raw_scores=(True,), extract_costs=True)
             rows = min(n, 65536)
             t0 = time.time()
             reps = 3
             for _ in range(reps):
                 eng.predict("bench", X[:rows], raw_score=True)
             dt_s = time.time() - t0
+            chunks = -(-rows // eng.max_batch)      # ceil
             serve = {
                 "predict_rows_per_sec": round(rows * reps / dt_s, 1),
                 "serve_recompiles_after_warmup":
                     eng.metrics.recompiles_after_warmup(),
+                # the timed window's bucket + dispatch count, for the
+                # roofline join (rows chunk at max_batch, padded pow-2)
+                "_predict_bucket": min(eng.max_batch, max(
+                    eng.min_bucket, 1 << (rows - 1).bit_length())),
+                "_predict_wall": (dt_s, float(reps * chunks)),
             }
         except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
             serve = {"predict_error": repr(e)[:200]}
@@ -322,33 +331,48 @@ def run_bench(backend_info: dict) -> dict:
                     phases["checkpoint_save_s"] / (5.0 * dt / iters), 5)
         except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
             phases = {"probe_error": str(e)[:200]}
-    # MFU estimate (BASELINE.md roofline denominator): the digit-factorized
-    # kernel spends K*B = 3*256 bf16 MACs per row-feature histogram visit
-    # per MXU pass, x2 passes (two-term bf16 split) = 1536 MACs = 3072
-    # FLOPs/visit (docs/Performance.md "Roofline"); a boosting iteration
-    # visits ~N*F*ceil(log2(L)) row-features (partition mode: each row is
-    # touched once per tree LEVEL it passes through). v5e peak ~197 TFLOPS
-    # bf16. GBDT is latency/VPU-bound, not matmul-dense — the point of the
-    # number is the denominator, not a target of 1.0.
-    # pick the bf16 peak for the chip generation that actually ran
-    # (public peak numbers; default to v5e when the kind is unknown)
-    _PEAKS = {"v4": 275e12, "v5e": 197e12,
-              "v5p": 459e12, "v6e": 918e12, "trillium": 918e12}
-    kind = str(backend_info.get("device_kind", "")).lower() \
-        .replace(" ", "").replace("_", "")
-    # normalize lite-generation names: 'tpuv6lite' -> v6e, 'v5lite*' -> v5e
-    kind = kind.replace("v6lite", "v6e").replace("v5lite", "v5e")
-    peak_flops = next((v for k, v in _PEAKS.items() if k in kind), 197e12)
-    flops_per_visit = 3 * 256 * 2 * 2.0
-    depth_avg = max(1.0, np.ceil(np.log2(max(num_leaves, 2))))
-    # only meaningful for an honest TPU run: zeroed with the throughput
-    # fields when the AUC guard fires, and not emitted against the v5e
-    # roofline for a CPU-shaped run
-    if train_auc_ok and not cpu_shaped:
-        mfu = (iters_per_sec * n * f * depth_avg * flops_per_visit
-               / peak_flops)
-    else:
-        mfu = 0.0
+    # MFU / HBM utilization (XLA-derived; obs/costmodel.py): per-entry
+    # FLOPs and bytes come from the compiler's own cost_analysis of the
+    # compiled programs — the old analytical flops-per-visit formula is
+    # gone. The fused train block's static cost over the best measured
+    # window gives achieved FLOP/s and B/s; dividing by the detected
+    # chip's peaks (CHIP_PEAKS — the table the old local _PEAKS became)
+    # gives mfu_estimate / hbm_util_estimate. GBDT histograms are
+    # memory-bound, so membw utilization is the number that tracks real
+    # headroom (both GPU GBDT papers argue from the same roofline).
+    mfu = 0.0
+    hbm_util = 0.0
+    roofline = {}
+    try:
+        from lightgbm_tpu.obs.costmodel import (detect_peaks,
+                                                get_cost_model,
+                                                roofline_table)
+        b.extract_cost_model(force=True)     # cached if the probe ran it
+        peaks = detect_peaks(backend_info.get("device_kind") or None)
+        wall = {"train_block": (dt, 1.0)}
+        for k, v in phases.items():
+            if k.startswith("frontier_hist_w") and isinstance(v, float):
+                wall[k] = (float(v), 1.0)
+        if serve.get("_predict_wall"):
+            wall["predict_b%d" % serve.pop("_predict_bucket")] = \
+                serve.pop("_predict_wall")
+        roofline = {
+            "device_kind": backend_info.get("device_kind", ""),
+            "peaks": peaks,          # None on CPU: achieved rates only
+            "rows": roofline_table(wall, peaks=peaks),
+        }
+        tb = get_cost_model().get("train_block")
+        # only meaningful for an honest accelerator run: zeroed with the
+        # throughput fields when the AUC guard fires, and never reported
+        # against a TPU peak for a CPU-shaped run
+        if tb and dt > 0 and peaks and train_auc_ok and not cpu_shaped:
+            mfu = tb["flops"] / dt / peaks["flops_per_s"]
+            hbm_util = tb["bytes_accessed"] / dt / peaks["hbm_bytes_per_s"]
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
+        roofline = {"error": repr(e)[:200]}
+    serve.pop("_predict_bucket", None)
+    serve.pop("_predict_wall", None)
+    phases.pop("roofline", None)             # superseded by the table above
     return {
         "metric": "boosting_iters_per_sec_higgs_equivalent "
                   "(binary GBDT, %dk rows x %d feat, %d leaves, 255 bins)"
@@ -357,6 +381,8 @@ def run_bench(backend_info: dict) -> dict:
         "unit": "iters/sec (normalized to 10.5M rows)",
         "vs_baseline": round(vs_baseline, 4),
         "mfu_estimate": round(float(mfu), 6),
+        "hbm_util_estimate": round(float(hbm_util), 6),
+        "roofline": roofline,
         "tree_growth": growth,
         "backend": backend_info.get("backend", "?"),
         "backend_fallback": bool(backend_info.get("fallback", False)),

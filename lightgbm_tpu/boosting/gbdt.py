@@ -533,6 +533,11 @@ class GBDT:
         self._iter_core = None
         self._compiled_block = None
         self._ladder_warmup: Optional[Dict[str, Any]] = None
+        # shape bookkeeping for PULL-based cost-model extraction
+        # (extract_cost_model): what the last fused block / flush looked
+        # like, so extraction can mirror the exact programs that ran
+        self._last_block_len = 0
+        self._last_flush_shapes: List[Any] = []
         self._valid_pred_cache: Dict[int, jnp.ndarray] = {}
 
     def add_valid_data(self, ds: BinnedDataset, metrics: List[Metric]) -> None:
@@ -1177,6 +1182,88 @@ class GBDT:
                 getattr(self.config, "compile_cache_dir", ""):
             self._ladder_warmup = self.warmup_wave_ladder()
 
+    def extract_cost_model(self, force: bool = False
+                           ) -> Dict[str, Dict[str, float]]:
+        """XLA cost-model extraction for this booster's compiled entry
+        points (obs/costmodel.py): the fused train block at its last
+        dispatched length, every frontier wave-width bucket's histogram
+        sweep, and the materialize flush at its last shape.  Per-entry
+        FLOPs / bytes / memory land as ``lgbm_costmodel_*`` gauges and
+        feed ``GET /roofline``, bench and the perf gate.
+
+        PULL-based by design: nothing in the training loop calls this,
+        so ``observability=none`` runs do zero costmodel work — and with
+        obs off it returns ``{}`` unless ``force=True`` (bench, probes
+        and the perf tools force it).  Arguments are mirrored as
+        ``jax.ShapeDtypeStruct`` (sharding preserved), never sampled:
+        extraction must not advance ``self._rng`` / ``self._bag_key`` or
+        resumed-run byte-identity would break.  AOT lowering shares no
+        cache with the executing programs, so this never recompiles or
+        perturbs them (pinned by tests/test_costmodel.py).
+        """
+        if not (force or self.obs.enabled):
+            return {}
+        from ..obs.costmodel import get_cost_model
+        cm = get_cost_model()
+        sds = jax.ShapeDtypeStruct
+
+        def _mirror_leaf(a):
+            if not hasattr(a, "shape") or not hasattr(a, "dtype"):
+                return a
+            try:
+                return sds(a.shape, a.dtype,
+                           sharding=getattr(a, "sharding", None))
+            except Exception:  # noqa: BLE001 - sharding kwarg is optional
+                return sds(a.shape, a.dtype)
+
+        def mirror(tree):
+            return jax.tree_util.tree_map(_mirror_leaf, tree)
+
+        out: Dict[str, Dict[str, float]] = {}
+        block = int(getattr(self, "_last_block_len", 0) or 0)
+        if self._compiled_block is not None and block > 0 \
+                and getattr(self, "_iter_capture", None) is not None:
+            f = self.train_data.num_features
+            fpad = getattr(self, "_feature_pad", 0)
+            key_arr = jnp.asarray(self._bag_key)
+            out["train_block"] = cm.analyze(
+                "train_block", self._compiled_block,
+                *mirror(self._iter_capture),
+                mirror(self.scores),
+                sds((block, f + fpad), jnp.bool_),      # feature_masks
+                sds((block,), jnp.float32),             # goss_actives
+                sds((block,), jnp.int32),               # iter_idxs
+                sds((block,) + tuple(key_arr.shape), key_arr.dtype),
+                mirror(self._bag_mask),
+                mirror(self._cegb_state),
+                mirror(self._stopped_dev),
+                sds((), jnp.float32),                   # lr
+                extra_key="block=%d" % block)
+        params = self.grow_params
+        if getattr(params, "frontier_mode", False) and self.mesh is None:
+            # mesh growth lowers inside shard_map on shard-local shapes;
+            # the standalone global-shape entry would not price it
+            from .. import bucketing
+            from ..core.grow_frontier import wave_hist_entry
+            widths = (bucketing.wave_width_ladder(params.num_leaves,
+                                                  params.max_depth)
+                      if params.frontier_bucketing
+                      else [bucketing.frontier_max_width(
+                          params.num_leaves, params.max_depth)])
+            n, ncols = self.xb.shape
+            for w in widths:
+                hfn, hargs, hkw = wave_hist_entry(
+                    n, ncols, self.xb.dtype, params, w)
+                name = "frontier_hist_w%d" % w
+                out[name] = cm.analyze(name, hfn, *hargs, **hkw)
+        flush = list(getattr(self, "_last_flush_shapes", ()))
+        if flush:
+            concat = jax.jit(lambda *bufs: jnp.concatenate(bufs, axis=0))
+            out["materialize"] = cm.analyze(
+                "materialize", concat, *flush,
+                extra_key="blocks=%d" % len(flush))
+        return out
+
     def train_many(self, num_iters: int) -> bool:
         """Run ``num_iters`` iterations, fusing them into on-device blocks
         when no per-iteration host work is required. Returns True when
@@ -1209,6 +1296,7 @@ class GBDT:
         done = 0
         while done < num_iters and not self._stopped:
             block = min(num_iters - done, 64)
+            self._last_block_len = block
             fn = self._compiled_block
             fmasks = jnp.stack([self._sample_feature_mask()
                                 for _ in range(block)])
@@ -1498,6 +1586,9 @@ class GBDT:
         l = self.config.num_leaves
         # every pending entry is a [B_i, K, T] block (B_i == 1 for
         # per-iteration dispatches); ONE transfer for the whole backlog
+        self._last_flush_shapes = [
+            jax.ShapeDtypeStruct(p["packed"].shape, p["packed"].dtype)
+            for p in pend]
         with self.obs.span("materialize", blocks=len(pend)):
             buf = np.asarray(jnp.concatenate([p["packed"] for p in pend],
                                              axis=0))  # [sum(B_i), K, T]

@@ -78,6 +78,27 @@ from .split import (FeatureMeta, K_MIN_SCORE, calculate_leaf_output,
                     find_best_split)
 
 
+def wave_hist_entry(n: int, xb_cols: int, xb_dtype, params: GrowParams,
+                    kw: int):
+    """The wave's one-dataset-sweep kernel — ``wave_step(kw)``'s
+    ``build_histogram_frontier`` call — as a standalone AOT-lowerable
+    entry point: returns ``(fn, args, kwargs)`` such that
+    ``fn.lower(*args, **kwargs)`` lowers exactly the program a width-
+    ``kw`` wave dispatches for its dataset sweep.  Args are
+    ``jax.ShapeDtypeStruct`` mirrors (no real arrays are built), so the
+    obs cost model and the perf gate price wave buckets through this one
+    definition and can never drift from the grower's actual kernel."""
+    sds = jax.ShapeDtypeStruct
+    args = (sds((n, xb_cols), jnp.dtype(xb_dtype)),
+            sds((n,), jnp.int32),          # slot: wave rank or -1
+            sds((n,), jnp.float32),        # grad
+            sds((n,), jnp.float32),        # hess
+            sds((n,), jnp.float32))        # sample mask
+    kwargs = dict(num_bins=params.num_bins, num_slots=int(kw),
+                  row_chunk=params.row_chunk, impl=params.hist_impl)
+    return build_histogram_frontier, args, kwargs
+
+
 class _FrontierState(NamedTuple):
     leaf_id: jnp.ndarray      # [N] int32
     hist_pool: jnp.ndarray    # [L, C, B, 3] per-leaf histograms

@@ -3,9 +3,16 @@
 One low-overhead observability layer shared by training, checkpointing and
 serving:
 
-- ``registry``: a process-wide, thread-safe counter/gauge/summary registry
-  with Prometheus text exposition and JSON snapshots.  serving/metrics.py
-  and profiling.py's compile-cache counters are both backed by it.
+- ``registry``: a process-wide, thread-safe counter/gauge/summary/histogram
+  registry with Prometheus text exposition and JSON snapshots.
+  serving/metrics.py and profiling.py's compile-cache counters are both
+  backed by it.
+- ``costmodel``: XLA cost-model extraction (FLOPs / bytes / memory per
+  compiled entry point via AOT ``cost_analysis``) and per-phase roofline
+  attribution against the detected chip's peaks — feeds ``GET /roofline``,
+  bench's ``mfu_estimate`` and the perf gate.
+- ``perfgate``: deterministic semantic perf counters + baseline comparison
+  (``PERF_COUNTERS.json``, ``tools/perf_gate.py``).
 - ``trace``: host-side span timers (device sync only at span close), a
   JSON-lines event stream, and an on-demand ``jax.profiler`` Perfetto
   capture helper for a configurable iteration window.
@@ -25,8 +32,10 @@ byte-identical when telemetry is disabled.
 from .health import (HEALTH_NONFINITE, HEALTH_NONFINITE_GAIN,  # noqa: F401
                      HEALTH_STUMP, HEALTH_VEC_LEN, HEALTH_WAVES,
                      HealthMonitor, HealthReport, health_vec)
-from .registry import (Counter, Gauge, MetricsRegistry,  # noqa: F401
-                       Summary, get_registry)
+from .costmodel import (CHIP_PEAKS, CostModel, detect_peaks,  # noqa: F401
+                        get_cost_model, roofline_snapshot)
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, Summary, get_registry)
 from .runtime import TrainingObs, resolve_health_action  # noqa: F401
 from .server import StatsServer  # noqa: F401
 from .trace import (EventStream, Tracer, perfetto_trace,  # noqa: F401

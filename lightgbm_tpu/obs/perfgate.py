@@ -1,0 +1,288 @@
+"""Deterministic perf-counter regression gate (tools/perf_gate.py's core).
+
+Wall-clock benchmarks cannot gate CI — a noisy shared runner swamps any
+real regression. This gate compares SEMANTIC performance counters
+instead: numbers that are fully determined by the algorithm and the
+compiler, independent of host speed, measured on a small fixed synthetic
+workload:
+
+- the wave-width ladder and clamped max width the frontier grower
+  dispatches (bucketing policy);
+- waves / dataset sweeps / occupancy-weighted slot sweeps per grown tree
+  (profiling.frontier_tree_stats — the O(depth) sweep guarantee);
+- backend compiles after warmup (the zero-recompile invariant: a second
+  fused block at the same length must compile NOTHING);
+- the device health-vector width (the fused block's per-iteration
+  telemetry contract);
+- the per-wave psum count of the sharded frontier grower (jaxpr string
+  count under an 8-device virtual mesh — one collective per wave);
+- XLA cost-model FLOPs / bytes per compiled entry point (train block +
+  every ladder bucket, obs/costmodel.py) — these DO drift across XLA
+  releases, so they carry relative tolerances; everything structural is
+  exact.
+
+The committed baseline (PERF_COUNTERS.json) declares every counter with
+its tolerance: ``{"value": v, "tol": t, "mode": "exact"|"rel"}``. A
+regression — a grower suddenly sweeping twice per wave, a recompile
+sneaking into the steady state, a bucketing change silently widening
+every wave — fails the gate with a readable diff naming the counter and
+both values. Intentional changes re-baseline with
+``python tools/perf_gate.py --write-baseline`` (docs/Observability.md).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+# the gate's fixed workload: small enough that measuring is seconds on
+# CPU, structured enough (depth-4 frontier ladder, fused block, flush)
+# that every counter above is exercised
+DEFAULT_WORKLOAD: Dict[str, Any] = {
+    "rows": 2048,
+    "features": 8,
+    "num_leaves": 15,
+    "max_depth": 4,
+    "iters": 3,
+    "seed": 0,
+    "backend": "cpu",
+}
+
+
+def default_spec(name: str) -> Dict[str, Any]:
+    """Tolerance policy for a counter name: XLA cost-model numbers drift
+    across compiler releases (fusion decisions change flop/byte
+    accounting), structural counters must not move at all."""
+    if name.startswith("costmodel_flops_"):
+        return {"mode": "rel", "tol": 0.25}
+    if name.startswith("costmodel_bytes_"):
+        return {"mode": "rel", "tol": 0.5}
+    return {"mode": "exact", "tol": 0}
+
+
+# ------------------------------------------------------------ measurement
+def _psum_per_wave() -> Optional[float]:
+    """Per-wave collective count of the sharded frontier grower, read
+    from the jaxpr string under an 8-device mesh (the pattern pinned by
+    tests/test_obs.py). None when fewer than 8 devices exist — the gate
+    CLI re-execs itself with a virtual-device flag to guarantee them."""
+    import jax
+    if len(jax.devices()) < 8:
+        return None
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..compat import shard_map
+    from ..core.grow import GrowParams
+    from ..core.grow_frontier import grow_tree_frontier
+    from ..core.split import FeatureMeta, SplitParams
+
+    r = np.random.RandomState(0)
+    n, f, b = 256, 4, 16
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    ones = np.ones(n, np.float32)
+    meta = FeatureMeta(
+        num_bin=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        penalty=jnp.ones((f,), jnp.float32),
+        monotone=jnp.zeros((f,), jnp.int32))
+    sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, max_delta_step=0.0,
+                     min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
+                     min_gain_to_split=0.0, max_cat_threshold=32,
+                     cat_smooth=10.0, cat_l2=10.0, max_cat_to_onehot=4,
+                     min_data_per_group=100)
+    params = GrowParams(num_leaves=7, num_bins=b, max_depth=3, split=sp,
+                        row_chunk=16384, hist_impl="scatter")
+    fmask = jnp.ones((f,), bool)
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def inner(xbj, gj, hj, mj):
+        return grow_tree_frontier(xbj, gj, hj, mj, meta, fmask, params,
+                                  axis_name="data")
+
+    shapes = jax.eval_shape(
+        lambda: grow_tree_frontier(jnp.asarray(xb), jnp.asarray(g),
+                                   jnp.asarray(ones), jnp.asarray(ones),
+                                   meta, fmask, params))
+    out_specs = jax.tree.map(lambda _: P(), shapes)
+    out_specs = (out_specs[0], P("data"), out_specs[2])
+    fn = shard_map(inner, mesh=mesh, in_specs=(P("data"),) * 4,
+                   out_specs=out_specs)
+    jaxpr = str(jax.make_jaxpr(fn)(xb, g, ones, ones))
+    waves = len(bucketing_ladder(params.num_leaves, params.max_depth))
+    total = jaxpr.count("psum")
+    # normalize by ladder width count so the counter reads "collectives
+    # per compiled wave branch", stable under ladder changes
+    return float(total) / max(waves, 1)
+
+
+def bucketing_ladder(num_leaves: int, max_depth: int) -> List[int]:
+    from .. import bucketing
+    return [int(w) for w in bucketing.wave_width_ladder(num_leaves,
+                                                        max_depth)]
+
+
+def measure(workload: Optional[Dict[str, Any]] = None
+            ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Train the gate workload and read every counter. Returns
+    ``(counters, workload)``. Deterministic by construction: fixed seed,
+    fixed shapes, semantic counters only — two runs on the same code +
+    jax produce identical values (pinned by tests/test_costmodel.py)."""
+    import jax
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from .. import bucketing
+    from ..profiling import (backend_compile_count, frontier_tree_stats,
+                             install_compile_hook)
+
+    wl = dict(DEFAULT_WORKLOAD)
+    wl.update(workload or {})
+    install_compile_hook()
+    rng = np.random.RandomState(int(wl["seed"]))
+    X = rng.randn(int(wl["rows"]), int(wl["features"])).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    bst = lgb.train(
+        {"objective": "binary", "verbosity": -1,
+         "num_leaves": int(wl["num_leaves"]),
+         "max_depth": int(wl["max_depth"]),
+         "tree_growth": "frontier", "observability": "none",
+         "seed": int(wl["seed"])},
+        lgb.Dataset(X, label=y), num_boost_round=int(wl["iters"]))
+    b = bst._impl
+    models = b.models                       # force the flush
+    counters: Dict[str, Any] = {}
+
+    ladder = bucketing_ladder(int(wl["num_leaves"]), int(wl["max_depth"]))
+    counters["frontier_ladder"] = ladder
+    counters["frontier_max_width"] = float(bucketing.frontier_max_width(
+        int(wl["num_leaves"]), int(wl["max_depth"])))
+    stats = frontier_tree_stats(models[0], b.grow_params)
+    counters["waves_per_tree"] = stats["waves"]
+    counters["dataset_sweeps_per_tree"] = stats["sweeps_per_tree"]
+    counters["slot_sweeps_per_tree"] = stats["slot_sweeps_per_tree"]
+    counters["wave_occupancy"] = round(stats["wave_occupancy"], 6)
+
+    # the fused block's telemetry contract: health rows are [block, W]
+    from .health import health_vec
+    counters["health_vec_width"] = float(jax.eval_shape(
+        health_vec,
+        jax.ShapeDtypeStruct((8,), jax.numpy.float32),
+        jax.ShapeDtypeStruct((8,), jax.numpy.float32),
+        jax.ShapeDtypeStruct((), jax.numpy.bool_)).shape[0])
+
+    # zero-recompile invariant: a second fused block at the same length
+    # must reuse the first block's executable (measured BEFORE cost
+    # extraction, whose own one-time AOT compiles are accounted apart)
+    c0 = backend_compile_count()
+    b.train_many(int(wl["iters"]))
+    counters["compiles_after_warmup"] = float(backend_compile_count() - c0)
+
+    costs = b.extract_cost_model(force=True)
+    for name in sorted(costs):
+        counters["costmodel_flops_" + name] = float(costs[name]["flops"])
+        counters["costmodel_bytes_" + name] = float(
+            costs[name]["bytes_accessed"])
+
+    psum = _psum_per_wave()
+    if psum is not None:
+        counters["psum_per_wave_branch"] = psum
+    return counters, wl
+
+
+# ------------------------------------------------------------ baseline IO
+def make_baseline(counters: Dict[str, Any],
+                  workload: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "schema": SCHEMA_VERSION,
+        "workload": dict(workload),
+        "counters": {
+            name: dict(default_spec(name), value=value)
+            for name, value in sorted(counters.items())
+        },
+    }
+
+
+def write_baseline(path: str, baseline: Dict[str, Any]) -> None:
+    with open(path, "w") as fh:
+        json.dump(baseline, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+# ------------------------------------------------------------ comparison
+def compare(baseline: Dict[str, Any], measured: Dict[str, Any]
+            ) -> Tuple[List[Dict[str, Any]], str]:
+    """Check measured counters against a baseline's declared tolerances.
+    Returns ``(violations, table)`` — ``violations`` empty means the
+    gate passes; ``table`` is an aligned human-readable diff of every
+    counter (printed by the CLI on pass AND fail, so CI logs always
+    show what was checked)."""
+    specs = baseline.get("counters", {})
+    rows: List[Tuple[str, str, str, str, str]] = []
+    violations: List[Dict[str, Any]] = []
+    for name in sorted(specs):
+        spec = specs[name]
+        want = spec.get("value")
+        mode = spec.get("mode", "exact")
+        tol = float(spec.get("tol", 0))
+        have = measured.get(name)
+        if have is None:
+            status = "MISSING"
+            violations.append({"counter": name, "baseline": want,
+                               "measured": None,
+                               "reason": "counter not measured"})
+        elif mode == "rel":
+            denom = max(abs(float(want)), 1e-12)
+            drift = abs(float(have) - float(want)) / denom
+            ok = drift <= tol
+            status = "ok (%.1f%% drift)" % (drift * 100) if ok else \
+                "FAIL (%.1f%% > %.0f%% tol)" % (drift * 100, tol * 100)
+            if not ok:
+                violations.append({
+                    "counter": name, "baseline": want, "measured": have,
+                    "reason": "drift %.3f exceeds rel tol %.3f"
+                    % (drift, tol)})
+        else:
+            ok = have == want
+            status = "ok" if ok else "FAIL (exact)"
+            if not ok:
+                violations.append({
+                    "counter": name, "baseline": want, "measured": have,
+                    "reason": "exact counter changed"})
+        rows.append((name, mode, _fmt(want), _fmt(have), status))
+    extra = sorted(set(measured) - set(specs))
+    for name in extra:
+        # new counters are informational, not failures: the baseline
+        # declares the contract, re-baselining admits new counters
+        rows.append((name, "-", "-", _fmt(measured[name]),
+                     "new (not in baseline)"))
+    widths = [max(len(r[i]) for r in rows + [_HDR]) for i in range(5)]
+    lines = [_fmt_row(_HDR, widths),
+             _fmt_row(tuple("-" * w for w in widths), widths)]
+    lines += [_fmt_row(r, widths) for r in rows]
+    return violations, "\n".join(lines) + "\n"
+
+
+_HDR = ("counter", "mode", "baseline", "measured", "status")
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    if isinstance(v, list):
+        return json.dumps(v)
+    return str(v)
+
+
+def _fmt_row(r: Tuple[str, ...], widths: List[int]) -> str:
+    return "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()

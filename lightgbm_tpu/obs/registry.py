@@ -1,4 +1,4 @@
-"""Process-wide metrics registry: counters, gauges, bounded summaries.
+"""Process-wide metrics registry: counters, gauges, summaries, histograms.
 
 One registry serves the whole process — training spans, health monitors,
 compile-cache accounting and the serving path all register here, so a
@@ -18,11 +18,12 @@ that never touch a device.
 """
 from __future__ import annotations
 
+import bisect
 import collections
 import json
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 _QUANTILES = (0.5, 0.9, 0.99)
 
@@ -151,6 +152,12 @@ class Summary(_Metric):
         with self._lock:
             return list(self._window)
 
+    @property
+    def total(self) -> float:
+        """Lifetime sum of observations (the ``_sum`` series)."""
+        with self._lock:
+            return self._sum
+
     def quantiles(self) -> Dict[float, float]:
         with self._lock:
             data = sorted(self._window)
@@ -175,10 +182,79 @@ class Summary(_Metric):
         return rows
 
 
+class Histogram(_Metric):
+    """Prometheus histogram: cumulative ``_bucket{le="..."}`` counts over
+    fixed bounds plus lifetime ``_sum`` / ``_count``.  Unlike Summary's
+    windowed quantiles — which cannot be aggregated after the fact —
+    bucket counts sum across processes and scrape intervals, which is
+    what serving request latency needs once more than one serving
+    process feeds a dashboard.  Bounds are configurable per metric and
+    fixed at registration (the first caller wins, like ``help``)."""
+
+    kind = "histogram"
+
+    # seconds-scale defaults (Prometheus client convention); latency-in-ms
+    # callers pass their own bounds
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, name, help, labels,
+                 buckets: Optional[Iterable[float]] = None):
+        super().__init__(name, help, labels)
+        bounds = tuple(sorted(float(b) for b in
+                              (buckets if buckets is not None
+                               else self.DEFAULT_BUCKETS)))
+        if not bounds:
+            raise ValueError("histogram %r needs at least one bucket bound"
+                             % name)
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)   # trailing +Inf bucket
+        self._sum = 0.0
+
+    @property
+    def bounds(self) -> Tuple[float, ...]:
+        return self._bounds
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            # le is inclusive: the first bound >= v owns the observation
+            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def samples(self):
+        with self._lock:
+            counts = list(self._counts)
+            s = self._sum
+        rows = []
+        cum = 0
+        for bound, c in zip(self._bounds, counts):
+            cum += c
+            rows.append((self.name + "_bucket",
+                         self.labels + (("le", "%g" % bound),), cum))
+        cum += counts[-1]
+        rows.append((self.name + "_bucket",
+                     self.labels + (("le", "+Inf"),), cum))
+        rows.append((self.name + "_sum", self.labels, s))
+        rows.append((self.name + "_count", self.labels, cum))
+        return rows
+
+
 class MetricsRegistry:
     """Get-or-create registry over ``(name, labels)`` keyed metrics."""
 
-    _KINDS = {"counter": Counter, "gauge": Gauge, "summary": Summary}
+    _KINDS = {"counter": Counter, "gauge": Gauge, "summary": Summary,
+              "histogram": Histogram}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -213,6 +289,11 @@ class MetricsRegistry:
                 labels: Optional[Dict[str, str]] = None,
                 window: int = 4096) -> Summary:
         return self._get("summary", name, help, labels, window=window)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[Dict[str, str]] = None,
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        return self._get("histogram", name, help, labels, buckets=buckets)
 
     def metrics(self) -> List[_Metric]:
         with self._lock:

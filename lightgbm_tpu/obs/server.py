@@ -6,9 +6,14 @@ metrics registry while a training run is live:
 - ``GET /metrics``  -> Prometheus text exposition (0.0.4)
 - ``GET /stats``    -> JSON snapshot of every registered series
 - ``GET /healthz``  -> ``{"status": "ok"|"anomalous", "anomalies": N}``
+- ``GET /roofline`` -> per-phase roofline attribution (obs/costmodel.py):
+  extracted FLOPs/bytes per entry point joined with span wall times
 
 Enabled via ``obs_stats_port`` (>= 0; 0 binds an OS-assigned port whose
-number is exported in ``StatsServer.port`` and logged).  The server binds
+number is exported in ``StatsServer.port`` and logged).  A busy port is
+not fatal: the constructor catches ``EADDRINUSE`` and falls back to an
+ephemeral port with a warning — a stale scraper or a second trainer on
+the same host must never kill training startup.  The server binds
 127.0.0.1 only — it is a diagnostics tap, not a service surface — and
 shares nothing mutable with the training loop beyond the thread-safe
 registry, so scrapes never block an iteration.
@@ -59,6 +64,15 @@ class _Handler(BaseHTTPRequestHandler):
                     "anomalies": n,
                 }).encode()
                 self._send(200, body, "application/json")
+            elif self.path == "/roofline":
+                # lazy import: costmodel itself is jax-free at module
+                # scope, but keep the server importable even if it ever
+                # is not
+                from .costmodel import roofline_snapshot
+                body = json.dumps(
+                    roofline_snapshot(registry=self.registry),
+                    sort_keys=True).encode()
+                self._send(200, body, "application/json")
             else:
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
@@ -83,7 +97,15 @@ class StatsServer:
                 "Non-finite grad/hess or gain anomalies detected in "
                 "training."),
         })
-        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        try:
+            self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        except OSError as e:
+            # EADDRINUSE (or any bind failure) on a diagnostics port must
+            # not kill training startup — fall back to an OS-assigned
+            # port and say where we actually landed
+            Log.warning("obs: stats port %d unavailable (%s); falling "
+                        "back to an ephemeral port" % (int(port), e))
+            self._httpd = ThreadingHTTPServer((host, 0), handler)
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])
@@ -95,7 +117,7 @@ class StatsServer:
             name="lgbm-obs-stats", daemon=True)
         self._thread.start()
         Log.info("obs: stats endpoint on http://%s:%d (metrics/stats/"
-                 "healthz)" % (self.host, self.port))
+                 "healthz/roofline)" % (self.host, self.port))
         return self
 
     def stop(self) -> None:

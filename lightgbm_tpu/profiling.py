@@ -247,30 +247,9 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
             # appears), so per-depth internal-node counts reconstruct each
             # wave's live width exactly.
             if booster.models:
-                t0 = booster.models[0]
-                live_at: Dict[int, int] = {}
-                stack = [(0, 0)] if t0.num_leaves > 1 else []
-                while stack:
-                    nd, d = stack.pop()
-                    live_at[d] = live_at.get(d, 0) + 1
-                    for ch in (int(t0.left_child[nd]),
-                               int(t0.right_child[nd])):
-                        if ch >= 0:      # ~leaf encoding: negative = leaf
-                            stack.append((ch, d + 1))
-                waves = (max(live_at) + 1) if live_at else 0
-                out["frontier_waves"] = float(waves)
-                out["frontier_sweeps_per_tree"] = float(waves + 1)
-                live = [live_at.get(w, 0) for w in range(waves)]
-                paid = [(bucketing.wave_width_bucket(
-                            lv, params.num_leaves, params.max_depth)
-                         if bucketed else kb) for lv in live]
-                # occupancy: live slots / paid bucket width, occupancy-
-                # weighted over the tree's waves; slot_sweeps is what the
-                # hist builder actually swept (fixed width pays waves*kb)
-                out["frontier_wave_occupancy"] = (
-                    float(sum(live)) / max(float(sum(paid)), 1.0))
-                out["frontier_slot_sweeps_per_tree"] = float(sum(paid))
-                out["frontier_slot_sweeps_fixed_width"] = float(waves * kb)
+                for k, v in frontier_tree_stats(booster.models[0],
+                                                params).items():
+                    out["frontier_" + k] = v
 
         sum_g = jnp.sum(g)
         sum_h = jnp.sum(h)
@@ -293,7 +272,59 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
         # snapshot save + restore on the booster's real model/shapes, so
         # the per-period cost shows up next to the phases it competes with
         out.update(_checkpoint_probe(booster))
-    return {k: round(v, 5) for k, v in out.items()}
+
+        # roofline attribution (obs/costmodel.py): join extracted XLA
+        # per-call costs with this probe's standalone wall times + any
+        # span totals the run accumulated. Best-effort — a probe must
+        # never fail because cost extraction cannot run here.
+        try:
+            from .obs.costmodel import (detect_peaks, roofline_table,
+                                        span_wall_times)
+            booster.extract_cost_model(force=True)
+            wall = span_wall_times()
+            for k, v in out.items():
+                if k.startswith("frontier_hist_w"):
+                    wall[k] = (float(v), 1.0)
+            out["roofline"] = roofline_table(wall, peaks=detect_peaks())
+        except Exception:  # noqa: BLE001
+            pass
+    return {k: (round(v, 5) if isinstance(v, float) else v)
+            for k, v in out.items()}
+
+
+def frontier_tree_stats(tree, params) -> Dict[str, float]:
+    """Deterministic per-tree wave accounting from a grown HostTree:
+    waves, dataset sweeps, occupancy and slot-sweeps under the
+    bucketing ladder. An internal node's depth IS the wave that
+    committed it (every positive-gain leaf splits at the first wave
+    after it appears), so per-depth internal-node counts reconstruct
+    each wave's live width exactly. Shared by phase_probe and the perf
+    gate (obs/perfgate.py) — semantic counters, no timing."""
+    from . import bucketing
+    bucketed = getattr(params, "frontier_bucketing", False)
+    kb = bucketing.frontier_max_width(params.num_leaves, params.max_depth)
+    live_at: Dict[int, int] = {}
+    stack = [(0, 0)] if tree.num_leaves > 1 else []
+    while stack:
+        nd, d = stack.pop()
+        live_at[d] = live_at.get(d, 0) + 1
+        for ch in (int(tree.left_child[nd]), int(tree.right_child[nd])):
+            if ch >= 0:              # ~leaf encoding: negative = leaf
+                stack.append((ch, d + 1))
+    waves = (max(live_at) + 1) if live_at else 0
+    live = [live_at.get(w, 0) for w in range(waves)]
+    paid = [(bucketing.wave_width_bucket(lv, params.num_leaves,
+                                         params.max_depth)
+             if bucketed else kb) for lv in live]
+    # occupancy: live slots / paid bucket width, occupancy-weighted over
+    # the tree's waves; slot_sweeps is what the hist builder actually
+    # swept (fixed width pays waves*kb)
+    return {"waves": float(waves),
+            "sweeps_per_tree": float(waves + 1),
+            "wave_occupancy": (float(sum(live))
+                               / max(float(sum(paid)), 1.0)),
+            "slot_sweeps_per_tree": float(sum(paid)),
+            "slot_sweeps_fixed_width": float(waves * kb)}
 
 
 def _checkpoint_probe(booster) -> Dict[str, float]:

@@ -6,7 +6,10 @@ labelled slice (``sink="serving-N"``) of shared ``lgbm_serving_*`` series,
 so the Prometheus exposition (serving ``/metrics/prometheus``, training
 stats endpoint) and this class's JSON snapshots read the SAME counters —
 no second bookkeeping path.  The public API and snapshot schema are
-unchanged from the pre-registry version (docs/Serving.md).
+unchanged from the pre-registry version (docs/Serving.md); request
+latency is exposed as a Prometheus HISTOGRAM
+(``lgbm_serving_request_latency_ms_bucket``) so multi-process scrapes
+can aggregate it, while the JSON snapshot's p50/p90/p99 view stays.
 
 Two sources of truth for "did we recompile":
 
@@ -43,6 +46,11 @@ _sink_seq = itertools.count()
 class ServingMetrics:
     """Aggregated serving counters + a bounded latency window."""
 
+    # sub-ms to multi-second: wide enough for a padded-batch compile-warm
+    # predict (sub-ms..ms) and a queue-inclusive cold request (seconds)
+    LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                          250.0, 500.0, 1000.0, 2500.0, 5000.0)
+
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
         self._t0 = time.time()
@@ -71,10 +79,16 @@ class ServingMetrics:
             "lgbm_serving_queue_depth",
             "Micro-batch queue depth (gauge, set by the batch queue).",
             labels=lbl)
-        self._s_latency = reg.summary(
+        # request latency is a HISTOGRAM (cumulative le-buckets), not a
+        # summary: bucket counts aggregate across serving processes and
+        # scrape intervals, which windowed quantiles cannot — Summary
+        # stays the right tool for in-process span timings.  The JSON
+        # snapshot keeps its p50/p90/p99 schema from a local window.
+        self._h_latency = reg.histogram(
             "lgbm_serving_request_latency_ms",
             "Request latency (milliseconds, queue-inclusive for batched "
-            "callers).", labels=lbl, window=window)
+            "callers).", labels=lbl, buckets=self.LATENCY_BUCKETS_MS)
+        self._lat_window = collections.deque(maxlen=window)
         self._batch_rows = collections.deque(maxlen=window)
         self._compile_floor = 0          # backend compiles at warmup end
         self._miss_floor = 0             # cache misses at warmup end
@@ -114,7 +128,10 @@ class ServingMetrics:
     def record_request(self, rows: int, latency_s: float) -> None:
         self._c_requests.inc()
         self._c_rows.inc(rows)
-        self._s_latency.observe(latency_s * 1000.0)
+        ms = latency_s * 1000.0
+        self._h_latency.observe(ms)
+        with self._lock:
+            self._lat_window.append(ms)
 
     def record_batch(self, rows: int) -> None:
         self._c_batches.inc()
@@ -148,7 +165,7 @@ class ServingMetrics:
     # ------------------------------------------------------------ export
     def snapshot(self) -> Dict:
         with self._lock:
-            lat = latency_summary(self._s_latency.values())
+            lat = latency_summary(list(self._lat_window))
             rows_per_batch = (float(sum(self._batch_rows))
                               / max(len(self._batch_rows), 1))
             return {

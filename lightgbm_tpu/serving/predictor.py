@@ -191,11 +191,24 @@ class ServingEngine:
     # ------------------------------------------------------------ warmup
     def warmup(self, model_ids: Optional[Iterable[str]] = None,
                raw_scores: Iterable[bool] = (False,),
-               num_iterations: Iterable[Optional[int]] = (None,)) -> int:
+               num_iterations: Iterable[Optional[int]] = (None,),
+               extract_costs: bool = False) -> int:
         """Compile every bucket for the given key prefixes so live traffic
         never compiles; returns the number of entries warmed. Marks the
-        metrics recompile floor when done."""
+        metrics recompile floor when done.
+
+        ``extract_costs=True`` additionally runs the obs cost model over
+        each warmed bucket (``predict_b<bucket>`` entries: XLA FLOPs /
+        bytes per forward pass, feeding ``GET /roofline`` and bench).
+        AOT extraction shares nothing with the serving executables, so it
+        cannot retrace them — and it runs BEFORE the recompile floor is
+        marked, so its own one-time compiles never trip the serving
+        zero-recompile assertion."""
         ids = list(model_ids) if model_ids is not None else self.registry.ids()
+        cm = None
+        if extract_costs:
+            from ..obs.costmodel import get_cost_model
+            cm = get_cost_model()
         warmed = 0
         for mid in ids:
             bundle = self.registry.get(mid)
@@ -208,5 +221,14 @@ class ServingEngine:
                         entry = self._predictor(bundle, b, raw, iters)
                         jax.block_until_ready(entry(zeros))
                         warmed += 1
+                        if cm is not None:
+                            cm.analyze(
+                                "predict_b%d" % b, entry._fn,
+                                jax.tree_util.tree_map(
+                                    lambda a: jax.ShapeDtypeStruct(
+                                        a.shape, a.dtype), entry._trees),
+                                jax.ShapeDtypeStruct((b, nf), jnp.float32),
+                                extra_key="model=%s;raw=%d;iters=%d"
+                                % (mid, int(raw), iters))
         self.metrics.mark_warmup_done()
         return warmed

@@ -1,0 +1,95 @@
+"""Deterministic perf-counter regression gate (tier1 CI).
+
+Measures the semantic performance counters of a small fixed frontier
+training workload (lightgbm_tpu/obs/perfgate.py: wave ladder, sweeps per
+tree, compiles-after-warmup, per-wave collectives, XLA cost-model FLOPs
+and bytes per entry point) and compares them against the committed
+baseline ``PERF_COUNTERS.json``. Counters are host-speed independent, so
+the gate is meaningful on any CI runner; tolerances live in the baseline
+itself (exact for structure, relative for XLA accounting drift).
+
+Exit 0 = every counter within its declared tolerance; 1 = drift, with an
+aligned diff table naming each violated counter and both values.
+Intentional changes re-baseline with ``--write-baseline`` and commit the
+result (docs/Observability.md documents the workflow).
+
+The script re-execs itself once with ``JAX_PLATFORMS=cpu`` and an
+8-virtual-device ``XLA_FLAGS`` so the sharded-grower collective counter
+can be measured anywhere — both must be set before jax first imports.
+"""
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)   # repo root for lightgbm_tpu
+
+_REEXEC_FLAG = "_LGBM_PERF_GATE_CHILD"
+_VDEV_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _reexec_with_virtual_devices() -> None:
+    """Counters must be platform-pinned and see 8 devices; both env vars
+    only take effect before jax's first import, hence the re-exec."""
+    if os.environ.get(_REEXEC_FLAG) == "1":
+        return
+    env = dict(os.environ)
+    env[_REEXEC_FLAG] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if _VDEV_FLAG not in flags:
+        env["XLA_FLAGS"] = (flags + " " + _VDEV_FLAG).strip()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def main() -> int:
+    _reexec_with_virtual_devices()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "PERF_COUNTERS.json"),
+                    help="committed baseline to gate against / write")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="measure and (re)write the baseline, no gating")
+    ap.add_argument("--out", default="",
+                    help="also write the measured counters JSON here")
+    args = ap.parse_args()
+
+    from lightgbm_tpu.obs import perfgate
+
+    counters, workload = perfgate.measure()
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump({"workload": workload, "counters": counters}, fh,
+                      indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.write_baseline:
+        baseline = perfgate.make_baseline(counters, workload)
+        perfgate.write_baseline(args.baseline, baseline)
+        print("wrote %s (%d counters)" % (args.baseline, len(counters)))
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print("perf_gate: no baseline at %s — run with --write-baseline "
+              "and commit it" % args.baseline, file=sys.stderr)
+        return 1
+    baseline = perfgate.load_baseline(args.baseline)
+    violations, table = perfgate.compare(baseline, counters)
+    print(table)
+    if violations:
+        print("perf_gate: %d counter(s) drifted beyond declared "
+              "tolerances:" % len(violations), file=sys.stderr)
+        for v in violations:
+            print("  %(counter)s: baseline=%(baseline)s "
+                  "measured=%(measured)s (%(reason)s)" % v,
+                  file=sys.stderr)
+        return 1
+    print("perf_gate: all %d counters within tolerance."
+          % len(baseline.get("counters", {})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
