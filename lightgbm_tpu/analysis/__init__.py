@@ -1,0 +1,38 @@
+"""Static analysis: JAX-aware source lint + compiled-program audit.
+
+Two layers, one exit-code contract (tools/analyze.py):
+
+- ``astlint``: an AST pass over the package source with JAX-specific
+  rules — tracer-unsafe Python inside jit-traced functions, host syncs,
+  weak-dtype array construction (the recompile class PR 4 fixed by
+  hand), f64-producing constructs on the device path, module-global
+  mutation under trace, and config-parameter reads the config table does
+  not declare.  Findings carry a rule ID, severity and a
+  ``# lgbm-lint: disable=RULE`` suppression channel.
+
+- ``jaxpr_audit`` / ``hlo_audit``: programmatic auditors that lower the
+  REAL entry points (fused train block, every ``wave_step(kw)`` ladder
+  bucket, serving predict buckets, materialize, the sharded grower under
+  the 8-virtual-device mesh) and verify invariants against the committed
+  ``ANALYSIS_BASELINE.json``: collective schedule (exact psum /
+  all-gather count and operand shapes per entry), zero f64 primitives,
+  no host callbacks in hot paths, donation effectiveness (declared
+  donated args really input-output aliased in the compiled executable),
+  and jaxpr structural fingerprints — "byte-identical grower" as a
+  one-line gate instead of a bespoke test per PR.
+
+Auditing is PULL-only: tracing/AOT lowering shares nothing with the
+executing programs (the discipline established by obs/costmodel.py), so
+an audit run never recompiles or perturbs training/serving executables.
+"""
+from .astlint import (Finding, LINT_RULES, lint_package, lint_paths,
+                      lint_source)
+from .jaxpr_audit import (collective_schedule, count_f64_eqns,
+                          host_callback_primitives, iter_eqns,
+                          primitive_sequence, structural_fingerprint)
+
+__all__ = [
+    "Finding", "LINT_RULES", "lint_source", "lint_paths", "lint_package",
+    "iter_eqns", "primitive_sequence", "structural_fingerprint",
+    "collective_schedule", "count_f64_eqns", "host_callback_primitives",
+]

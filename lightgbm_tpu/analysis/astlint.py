@@ -1,0 +1,661 @@
+"""JAX-aware AST lint over the package source (layer 1 of the analyzer).
+
+Every rule here encodes a failure class this repo has already paid for
+once by hand:
+
+- ``LGL101`` tracer-unsafe branch: a Python ``if``/``while`` whose test
+  consumes a traced value inside a jit-traced function raises
+  ``TracerBoolConversionError`` at trace time — or worse, silently
+  specializes when the value is concrete on the first call.
+- ``LGL102`` tracer concretization: ``float()`` / ``int()`` / ``bool()``
+  / ``.item()`` / ``.tolist()`` on traced values force a host sync (or a
+  trace error) from inside compiled code.
+- ``LGL103`` host sync: ``jax.block_until_ready`` / ``jax.device_get``
+  stall the dispatch pipeline; the only approved sites are span closes
+  (obs/trace.py), warmup, and explicit probes — each carries an inline
+  suppression with its reason.
+- ``LGL104`` weak-dtype construction: dtype-less ``jnp.arange`` /
+  ``zeros`` / ``ones`` / ``full`` / ``linspace`` in jit-traced code is
+  the recompile class PR 4 fixed by hand in ``train_many`` (a nonzero-
+  start ``jnp.arange`` compiled a stray ``convert_element_type`` on the
+  second block).
+- ``LGL105`` f64 construct: ``jnp.float64`` / ``dtype="float64"`` /
+  x64-mode flips produce f64 device programs; the frontier path is
+  f32-only by contract (the explicitly gated ``gpu_use_dp`` fallback is
+  the one suppressed exception).  Host-side ``np.float64`` is fine and
+  never flagged.
+- ``LGL106`` global mutation under trace: assigning module globals (or
+  mutating module-level containers) inside a jit-traced function runs at
+  TRACE time, not call time — a classic silent-staleness bug.
+- ``LGL107`` unvalidated config read: ``cfg.<name>`` / ``config.<name>``
+  / ``self.config.<name>`` where ``<name>`` is not a canonical parameter
+  or declared Config attribute — the typo class config.py's table
+  validation exists to catch.
+
+Suppression: ``# lgbm-lint: disable=LGL104`` on the finding's line (or
+the line directly above, for long expressions), comma-separated for
+multiple rules, free text after the rule list as the reason.  A file-
+level ``# lgbm-lint: disable-file=LGL103`` in the first ten lines
+suppresses a rule for the whole file.
+
+The linter is pure AST — it never imports the linted modules.  Only
+``LGL107`` imports ``lightgbm_tpu.config`` (for the parameter table),
+and skips itself if that import fails.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# rule id -> (severity, summary)
+LINT_RULES: Dict[str, Tuple[str, str]] = {
+    "LGL101": ("error",
+               "tracer-unsafe Python branch on a traced value inside a "
+               "jit-traced function"),
+    "LGL102": ("error",
+               "tracer concretization (float()/int()/bool()/.item()/"
+               ".tolist()) inside a jit-traced function"),
+    "LGL103": ("warning",
+               "host sync (block_until_ready / device_get) outside an "
+               "approved, suppressed site"),
+    "LGL104": ("error",
+               "dtype-less jnp array construction in jit-traced code "
+               "(weak-dtype recompile hazard)"),
+    "LGL105": ("error",
+               "f64-producing construct on the device path"),
+    "LGL106": ("error",
+               "module-global mutation inside a jit-traced function"),
+    "LGL107": ("warning",
+               "config parameter read that config.py does not declare"),
+}
+
+_SUPPRESS_TOKEN = "lgbm-lint:"
+
+# decorator / call names that make a function's body run under trace
+_TRACING_DECORATORS = {
+    "jit", "vmap", "pmap", "shard_map", "checkpoint", "remat", "grad",
+    "value_and_grad", "custom_jvp", "custom_vjp",
+}
+# call targets whose function-valued arguments are traced
+_TRACING_CALLS = {
+    "jit", "vmap", "pmap", "shard_map", "scan", "while_loop", "cond",
+    "switch", "fori_loop", "map", "associative_scan", "checkpoint",
+    "remat", "grad", "value_and_grad", "eval_shape", "make_jaxpr",
+}
+# the subset that CALLS its function argument with tracer positionals
+# (a scan body's carry/xs ARE tracers, no array evidence required) —
+# unlike jit-likes, whose params may be static config (strings, ints)
+_CONTROL_FLOW_CALLS = {
+    "scan", "while_loop", "cond", "switch", "fori_loop", "map",
+    "associative_scan",
+}
+# jnp constructors with their minimum positional-arg count that already
+# includes an explicit dtype (so fewer positionals + no dtype= kwarg
+# means the default/weak dtype is taken)
+_DTYPE_CONSTRUCTORS = {
+    "arange": 4, "zeros": 2, "ones": 2, "empty": 2, "full": 3,
+    "linspace": 7,
+}
+_CONCRETIZERS = {"float", "int", "bool"}
+_CONCRETIZER_METHODS = {"item", "tolist"}
+_HOST_SYNCS = {"block_until_ready", "device_get"}
+_JNP_ALIASES = {"jnp", "jdn", "jax_numpy"}   # import jax.numpy as jnp
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return "%s:%d:%d: %s [%s] %s" % (
+            self.path, self.line, self.col, self.severity, self.rule,
+            self.message)
+
+
+# ------------------------------------------------------------ suppression
+def _suppressions(src: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Per-line and file-level suppressed rule sets from lint comments."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        if _SUPPRESS_TOKEN not in line:
+            continue
+        tail = line.split(_SUPPRESS_TOKEN, 1)[1].strip()
+        file_level = tail.startswith("disable-file=")
+        if not (file_level or tail.startswith("disable=")):
+            continue
+        spec = tail.split("=", 1)[1]
+        # the rule list ends at the first whitespace; everything after
+        # is the human reason and ignored by the parser
+        rules = {r.strip() for r in spec.split()[0].split(",") if r.strip()}
+        if file_level and i <= 10:
+            per_file |= rules
+        elif not file_level:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, per_file
+
+
+def _suppressed(rule: str, line: int, per_line: Dict[int, Set[str]],
+                per_file: Set[str]) -> bool:
+    for rules in (per_file, per_line.get(line, ()),
+                  per_line.get(line - 1, ())):
+        if rule in rules or "all" in rules:
+            return True
+    return False
+
+
+# ------------------------------------------------------------ AST helpers
+def _root_name(node: ast.AST) -> Optional[str]:
+    """a.b.c -> 'a'; foo -> 'foo'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jnp_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    """True for ``jnp.<attr>`` / ``jax.numpy.<attr>`` attribute nodes."""
+    if not isinstance(node, ast.Attribute):
+        return False
+    if attr is not None and node.attr != attr:
+        return False
+    v = node.value
+    if isinstance(v, ast.Name) and v.id in _JNP_ALIASES:
+        return True
+    return (isinstance(v, ast.Attribute) and v.attr == "numpy"
+            and isinstance(v.value, ast.Name) and v.value.id == "jax")
+
+
+def _func_args(call: ast.Call) -> List[ast.AST]:
+    """Function-valued argument candidates of a tracing call: bare args
+    plus elements of list/tuple args (lax.switch branch lists)."""
+    out: List[ast.AST] = []
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, (ast.List, ast.Tuple)):
+            out.extend(a.elts)
+        else:
+            out.append(a)
+    return out
+
+
+class _Parents(ast.NodeVisitor):
+    def __init__(self):
+        self.parent: Dict[ast.AST, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parent[child] = node
+        super().generic_visit(node)
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _collect_traced_functions(tree: ast.Module) -> Dict[ast.AST, bool]:
+    """Function/lambda nodes whose bodies run under jax tracing: those
+    with tracing decorators, those passed (by name or inline) to tracing
+    calls anywhere in the module, and everything nested inside one —
+    nested defs execute at trace time.
+
+    Maps each node to a STRICT flag: True when the function is a
+    control-flow body (scan/cond/while_loop...), whose positional
+    parameters are tracers by construction; False for jit-likes, where
+    parameters may be static config and the array-evidence pass decides."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: Dict[ast.AST, bool] = {}
+
+    def mark(fn: ast.AST, strict: bool) -> None:
+        traced[fn] = traced.get(fn, False) or strict
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = (target.attr if isinstance(target, ast.Attribute)
+                        else getattr(target, "id", None))
+                if name in _TRACING_DECORATORS:
+                    mark(node, False)
+                # @partial(jax.jit, ...) — the tracer is the first arg
+                if isinstance(dec, ast.Call) and name == "partial":
+                    for a in dec.args[:1]:
+                        an = (a.attr if isinstance(a, ast.Attribute)
+                              else getattr(a, "id", None))
+                        if an in _TRACING_DECORATORS:
+                            mark(node, False)
+        elif isinstance(node, ast.Call):
+            target = node.func
+            name = (target.attr if isinstance(target, ast.Attribute)
+                    else getattr(target, "id", None))
+            if name not in _TRACING_CALLS:
+                continue
+            strict = name in _CONTROL_FLOW_CALLS
+            for a in _func_args(node):
+                if isinstance(a, ast.Lambda):
+                    mark(a, strict)
+                elif isinstance(a, ast.Name) and a.id in defs_by_name:
+                    for d in defs_by_name[a.id]:
+                        mark(d, strict)
+
+    # transitive closure over nesting: inner defs run at trace time but
+    # their own params are evidence-based unless separately marked
+    changed = True
+    while changed:
+        changed = False
+        for t in list(traced):
+            for inner in ast.walk(t):
+                if inner is not t and isinstance(inner, _FUNC_NODES) \
+                        and inner not in traced:
+                    traced[inner] = False
+                    changed = True
+    return traced
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    for extra in (args.vararg, args.kwarg):
+        if extra is not None:
+            names.add(extra.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+_ARRAY_METHODS = {
+    "astype", "reshape", "sum", "mean", "max", "min", "argmax", "argmin",
+    "cumsum", "take", "dot", "at", "set", "add", "transpose", "squeeze",
+    "ravel", "flatten", "clip",
+}
+
+
+def _array_evidence(fn: ast.AST) -> Set[str]:
+    """Names the function body uses AS ARRAYS: subscripted, passed as
+    the leading argument of a jnp/lax/jax call, or the receiver of an
+    array method.  Parameters without such evidence are treated as
+    static Python values (``impl`` strings, ``row_chunk`` ints) — the
+    distinction a purely syntactic tracer analysis cannot otherwise
+    make, and the one that keeps LGL101/102 precise."""
+    ev: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name):
+            ev.add(node.value.id)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            root = _root_name(func)
+            jaxish = root in _JNP_ALIASES | {"jax", "lax"} or \
+                (isinstance(func, ast.Attribute) and _is_jnp_attr(func))
+            if jaxish and node.args:
+                for sub in ast.walk(node.args[0]):
+                    if isinstance(sub, ast.Name):
+                        ev.add(sub.id)
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _ARRAY_METHODS and \
+                    isinstance(func.value, ast.Name):
+                ev.add(func.value.id)
+    return ev
+
+
+def _strict_param_names(fn: ast.AST) -> Set[str]:
+    """Positional parameters WITHOUT defaults — the ones a control-flow
+    combinator fills with tracers.  Defaulted params (``with_forced:
+    bool = False``) stay evidence-based: the combinator never passes
+    them, so they keep their static default."""
+    args = fn.args
+    pos = list(args.posonlyargs) + list(args.args)
+    if args.defaults:
+        pos = pos[:len(pos) - len(args.defaults)]
+    names = {a.arg for a in pos}
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _traced_names(fn: ast.AST, inherited: Set[str],
+                  strict: bool = False) -> Set[str]:
+    """Array-evidenced parameter names (plus ALL no-default positionals
+    for control-flow bodies) plus locals assigned from traced
+    expressions — a bounded forward propagation, not full dataflow."""
+    traced = (_param_names(fn) & _array_evidence(fn)) | set(inherited)
+    if strict:
+        traced |= _strict_param_names(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for _ in range(4):
+        added = False
+        for node in body:
+            for sub in ast.walk(node):
+                if isinstance(sub, _FUNC_NODES):
+                    continue
+                if isinstance(sub, ast.Assign) and \
+                        _uses_traced(sub.value, traced):
+                    for tgt in sub.targets:
+                        for t in ast.walk(tgt):
+                            if isinstance(t, ast.Name) and \
+                                    t.id not in traced:
+                                traced.add(t.id)
+                                added = True
+        if not added:
+            break
+    return traced
+
+
+def _uses_traced(expr: ast.AST, traced: Set[str]) -> bool:
+    """Whether ``expr`` consumes a traced value *as data*: a bare Name
+    or a Subscript of one.  Attribute chains (``params.foo`` — static
+    config objects; ``x.shape`` — static on tracers), ``is``/``is not``
+    comparisons and ``isinstance``/``len``/``getattr`` calls never
+    count: they are legal on tracers / static carriers."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            continue
+        if isinstance(node, ast.Call):
+            fname = (node.func.id if isinstance(node.func, ast.Name)
+                     else None)
+            if fname in ("isinstance", "len", "getattr", "hasattr",
+                         "type"):
+                return False  # static-inspection call dominates the test
+        if isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            # comparison against a string constant is static dispatch
+            # (`impl == "scatter"`) — a tracer never compares to a str
+            if any(isinstance(c, ast.Constant) and isinstance(c.value, str)
+                   for c in [node.left] + list(node.comparators)):
+                return False
+    # second pass: find a data use that is not behind an Attribute
+    return _has_bare_use(expr, traced)
+
+
+def _has_bare_use(expr: ast.AST, traced: Set[str]) -> bool:
+    if isinstance(expr, ast.Attribute):
+        return False   # x.anything is a static read
+    if isinstance(expr, ast.Name):
+        return expr.id in traced
+    if isinstance(expr, ast.Subscript):
+        return _has_bare_use(expr.value, traced) or \
+            _has_bare_use(expr.slice, traced)
+    if isinstance(expr, ast.Call):
+        # an array-method result is traced iff its receiver is
+        # (`xb.reshape(...)`, `g.astype(...)`); a plain call is traced
+        # iff an argument is — the callee name itself is not a data use
+        func = expr.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _ARRAY_METHODS and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id in traced:
+            return True
+        return any(_has_bare_use(a, traced)
+                   for a in list(expr.args)
+                   + [kw.value for kw in expr.keywords])
+    if isinstance(expr, _FUNC_NODES):
+        return False
+    return any(_has_bare_use(c, traced) for c in ast.iter_child_nodes(expr))
+
+
+# ------------------------------------------------------------ the linter
+class _Linter:
+    def __init__(self, src: str, path: str,
+                 known_params: Optional[Set[str]]):
+        self.src = src
+        self.path = path
+        self.known_params = known_params
+        self.findings: List[Finding] = []
+        self.per_line, self.per_file = _suppressions(src)
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if _suppressed(rule, line, self.per_line, self.per_file):
+            return
+        sev = LINT_RULES[rule][0]
+        self.findings.append(Finding(rule, sev, self.path, line,
+                                     getattr(node, "col_offset", 0),
+                                     message))
+
+    # -------------------------------------------------------- module-wide
+    def run(self) -> List[Finding]:
+        try:
+            tree = ast.parse(self.src)
+        except SyntaxError as exc:
+            self.findings.append(Finding(
+                "LGL000", "error", self.path, exc.lineno or 1, 0,
+                "syntax error: %s" % exc.msg))
+            return self.findings
+        module_globals = {
+            t.id for node in tree.body
+            for stmt in ([node] if isinstance(node, (ast.Assign,
+                                                     ast.AnnAssign)) else [])
+            for t in ast.walk(stmt)
+            if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store)}
+        traced_fns = _collect_traced_functions(tree)
+        # attributes used as call targets (`cfg.update(...)`) are method
+        # accesses, not parameter reads — LGL107 skips them
+        call_funcs = {node.func for node in ast.walk(tree)
+                      if isinstance(node, ast.Call)}
+
+        for node in ast.walk(tree):
+            self._check_host_sync(node)
+            self._check_f64(node)
+            self._check_config_read(node, call_funcs)
+
+        # scoped rules: walk each traced function once, skipping nested
+        # function bodies (they are themselves in traced_fns)
+        for fn, strict in traced_fns.items():
+            inherited: Set[str] = set()
+            self._lint_traced_fn(fn, inherited, module_globals, strict)
+        seen: Set[Tuple[str, int, int]] = set()
+        uniq: List[Finding] = []
+        for f in self.findings:
+            key = (f.rule, f.line, f.col)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        self.findings = uniq
+        return self.findings
+
+    # -------------------------------------------------------- LGL103/105/107
+    def _check_host_sync(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HOST_SYNCS:
+            self.emit("LGL103", node,
+                      "host sync `%s` — approved sites (span close, "
+                      "warmup, probes) must suppress with a reason"
+                      % node.func.attr)
+
+    def _check_f64(self, node: ast.AST) -> None:
+        if _is_jnp_attr(node, "float64") or _is_jnp_attr(node, "double"):
+            self.emit("LGL105", node,
+                      "jnp.float64 on the device path (f32-only contract)")
+            return
+        if isinstance(node, ast.Call):
+            fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                     else getattr(node.func, "id", None))
+            if fname == "update":
+                args = node.args
+                if args and isinstance(args[0], ast.Constant) and \
+                        args[0].value == "jax_enable_x64":
+                    self.emit("LGL105", node,
+                              "flipping jax_enable_x64 switches the whole "
+                              "process to f64 semantics")
+            # dtype="float64" passed into a jnp/jax call
+            if isinstance(node.func, ast.Attribute) and \
+                    (_is_jnp_attr(node.func.value) or
+                     _root_name(node.func) in _JNP_ALIASES | {"jax", "lax"}):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value in ("float64", "f64", "double"):
+                        self.emit("LGL105", node,
+                                  'dtype="float64" in a jax call')
+
+    def _check_config_read(self, node: ast.AST,
+                           call_funcs: Set[ast.AST]) -> None:
+        if self.known_params is None or not isinstance(node, ast.Attribute):
+            return
+        if not isinstance(node.ctx, ast.Load) or node in call_funcs:
+            return
+        if _root_name(node) == "jax":
+            return  # jax.config.* is the jax runtime config, not ours
+        v = node.value
+        is_cfg = (isinstance(v, ast.Name) and v.id in ("cfg", "config")) \
+            or (isinstance(v, ast.Attribute) and v.attr == "config")
+        if is_cfg and not node.attr.startswith("_") and \
+                node.attr not in self.known_params:
+            self.emit("LGL107", node,
+                      "config attribute `%s` is not declared in "
+                      "config.py's parameter table" % node.attr)
+
+    # -------------------------------------------------------- traced scope
+    def _lint_traced_fn(self, fn: ast.AST, inherited: Set[str],
+                        module_globals: Set[str],
+                        strict: bool = False) -> None:
+        traced = _traced_names(fn, inherited, strict)
+        globals_declared: Set[str] = set()
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+        def walk_scope(node: ast.AST):
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    continue   # nested fn: separate traced scope
+                yield from walk_scope(child)
+
+        for stmt in body:
+            for node in walk_scope(stmt):
+                if isinstance(node, (ast.If, ast.While)):
+                    if _uses_traced(node.test, traced):
+                        self.emit(
+                            "LGL101", node,
+                            "`%s` on a traced value — use lax.cond / "
+                            "jnp.where / lax.while_loop"
+                            % ("while" if isinstance(node, ast.While)
+                               else "if"))
+                elif isinstance(node, ast.Call):
+                    self._check_concretize(node, traced)
+                    self._check_weak_dtype(node)
+                elif isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+                    self.emit("LGL106", node,
+                              "`global %s` inside a jit-traced function "
+                              "runs at trace time, not call time"
+                              % ", ".join(node.names))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    self._check_global_mutation(node, traced,
+                                                module_globals,
+                                                globals_declared)
+
+    def _check_concretize(self, node: ast.Call, traced: Set[str]) -> None:
+        fname = getattr(node.func, "id", None)
+        if fname in _CONCRETIZERS and node.args and \
+                _uses_traced(node.args[0], traced):
+            self.emit("LGL102", node,
+                      "`%s()` of a traced value forces concretization"
+                      % fname)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONCRETIZER_METHODS and \
+                _has_bare_use(node.func.value, traced):
+            self.emit("LGL102", node,
+                      "`.%s()` of a traced value forces a host sync"
+                      % node.func.attr)
+
+    def _check_weak_dtype(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        ctor = node.func.attr
+        if ctor not in _DTYPE_CONSTRUCTORS or not _is_jnp_attr(node.func):
+            return
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return
+        if len(node.args) >= _DTYPE_CONSTRUCTORS[ctor]:
+            return
+        self.emit("LGL104", node,
+                  "dtype-less `jnp.%s` in jit-traced code — weak/default "
+                  "dtypes recompile when the surrounding types shift "
+                  "(the train_many arange regression)" % ctor)
+
+    def _check_global_mutation(self, node: ast.AST, traced: Set[str],
+                               module_globals: Set[str],
+                               globals_declared: Set[str]) -> None:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id in globals_declared:
+                self.emit("LGL106", node,
+                          "assignment to global `%s` inside a jit-traced "
+                          "function" % tgt.id)
+            elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                base = tgt
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                base = base.id if isinstance(base, ast.Name) else None
+                if base is not None and base in module_globals and \
+                        base not in traced and not base.startswith("__"):
+                    self.emit(
+                        "LGL106", node,
+                        "mutation of module-level `%s` inside a "
+                        "jit-traced function happens at trace time"
+                        % base)
+
+
+# ------------------------------------------------------------ entry points
+def _known_config_params() -> Optional[Set[str]]:
+    """Canonical names + aliases + declared Config attributes, or None
+    when the package is not importable (pure-AST contexts)."""
+    try:
+        from .. import config as config_mod
+        cfg = config_mod.Config({})
+        names = set(config_mod._CANON) | set(config_mod._ALIASES)
+        names |= set(vars(cfg))
+        names |= {a for a in dir(config_mod.Config)
+                  if not a.startswith("_")}
+        return names
+    except Exception:  # noqa: BLE001 - lint must run without the package
+        return None
+
+
+def lint_source(src: str, path: str = "<string>",
+                known_params: Optional[Set[str]] = None,
+                resolve_params: bool = True) -> List[Finding]:
+    if known_params is None and resolve_params:
+        known_params = _known_config_params()
+    return _Linter(src, path, known_params).run()
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    known = _known_config_params()
+    findings: List[Finding] = []
+    for p in sorted(paths):
+        with open(p, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), p, known_params=known,
+                                        resolve_params=False))
+    return findings
+
+
+def package_sources(root: Optional[str] = None) -> List[str]:
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".jax_cache")]
+        out.extend(os.path.join(dirpath, f) for f in filenames
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def lint_package(root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py file of the installed package (or ``root``)."""
+    return lint_paths(package_sources(root))

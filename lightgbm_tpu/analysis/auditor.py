@@ -1,0 +1,287 @@
+"""The audit runner: lower every hot entry point, verify invariants
+against the committed ``ANALYSIS_BASELINE.json``.
+
+One deterministic audit workload (small enough to trace in seconds,
+shaped to exercise the full [1, 2, 4, 8] wave-width ladder) is trained
+in-process; every entry point the repo dispatches is then mirrored as
+``ShapeDtypeStruct`` and traced with ``jax.make_jaxpr`` — pure tracing,
+zero compiles — except the donation check, which AOT-compiles ONE
+program under the costmodel discipline (AOT shares no cache with
+executing programs).
+
+Entries audited:
+
+- ``train_block``        the fused boosting block (unjitted core, the
+                         exact signature the executing jit compiled)
+- ``frontier_hist_w<k>`` every wave-width ladder bucket, via
+                         ``core.grow_frontier.wave_hist_entry``
+- ``materialize``        the tree-flush concatenation
+- ``grower``             the unsharded frontier grower (the structural
+                         fingerprint PR 6 pinned as a string compare)
+- ``grower_sharded``     the 8-virtual-device shard_map grower (the
+                         psum schedule PR 5 pinned by hand)
+- ``predict_b<bucket>``  every serving bucket's forward pass
+
+Hard invariants hold regardless of baseline content: zero f64 equations
+and zero host callbacks in every entry, and every declared train-block
+donation actually aliased.  Everything else (fingerprints, collective
+schedules, equation counts) is compared exactly against the baseline —
+re-baselining is an explicit, reviewed act (``tools/analyze.py
+--write-baseline``).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import hlo_audit, jaxpr_audit
+
+BASELINE_NAME = "ANALYSIS_BASELINE.json"
+SCHEMA = 1
+
+# deterministic audit workload: frontier growth with the full
+# [1, 2, 4, 8] wave ladder, bucketed serving at two buckets
+AUDIT_WORKLOAD: Dict[str, Any] = {
+    "rows": 256, "features": 4, "num_leaves": 15, "max_depth": 4,
+    "iters": 3, "seed": 0, "min_bucket": 32, "max_batch": 64,
+}
+
+
+def _train_audit_booster(wl: Dict[str, Any]):
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(wl["seed"])
+    X = rng.randn(wl["rows"], wl["features"]).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbosity": -1,
+              "num_leaves": wl["num_leaves"], "max_depth": wl["max_depth"],
+              "tree_growth": "frontier", "seed": wl["seed"]}
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=wl["iters"])
+    bst._impl.models          # flush: sets block/flush shapes
+    return bst
+
+
+def collect_audit(workload: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Measure every entry's invariant record on the current source.
+    Returns ``{"entries": {...}, "donation": {...}, "workload": ...}``."""
+    import jax
+    import jax.numpy as jnp
+
+    wl = dict(AUDIT_WORKLOAD)
+    if workload:
+        wl.update(workload)
+    bst = _train_audit_booster(wl)
+    b = bst._impl
+    sds = jax.ShapeDtypeStruct
+    entries: Dict[str, Dict[str, Any]] = {}
+
+    # ---- fused train block (exact executing signature)
+    block = int(getattr(b, "_last_block_len", 0) or 0)
+    if block > 0 and getattr(b, "_iter_capture", None) is not None:
+        run_block = b._build_run_block()
+        args = b.train_block_sds(block)
+        entries["train_block"] = jaxpr_audit.audit_jaxpr(
+            jax.make_jaxpr(run_block)(*args))
+
+    # ---- every wave-width ladder bucket
+    from .. import bucketing
+    from ..core.grow_frontier import wave_hist_entry
+    params = b.grow_params
+    n, ncols = b.xb.shape
+    for w in bucketing.wave_width_ladder(params.num_leaves,
+                                         params.max_depth):
+        fn, hargs, hkw = wave_hist_entry(n, ncols, b.xb.dtype, params, w)
+        entries["frontier_hist_w%d" % w] = jaxpr_audit.audit_jaxpr(
+            jax.make_jaxpr(functools.partial(fn, **hkw))(*hargs))
+
+    # ---- materialize flush
+    flush = list(getattr(b, "_last_flush_shapes", ()))
+    if flush:
+        entries["materialize"] = jaxpr_audit.audit_jaxpr(
+            jax.make_jaxpr(lambda *bufs: jnp.concatenate(bufs, axis=0))(
+                *flush))
+
+    # ---- unsharded grower (the PR 6 "byte-identical grower" compare)
+    from ..core.grow_frontier import grow_tree_frontier
+    f = b.xb.shape[1]
+    fmask = jnp.ones((f,), bool)
+    entries["grower"] = jaxpr_audit.audit_jaxpr(jax.make_jaxpr(
+        lambda xb, g, h, m: grow_tree_frontier(
+            xb, g, h, m, b.feature_meta, fmask, b.grow_params))(
+        sds(b.xb.shape, b.xb.dtype), sds((n,), jnp.float32),
+        sds((n,), jnp.float32), sds((n,), jnp.float32)))
+
+    # ---- sharded grower under the 8-virtual-device mesh (PR 5 psums)
+    sharded = jaxpr_audit.sharded_frontier_fn()
+    if sharded is not None:
+        sfn, sargs, _ = sharded
+        entries["grower_sharded"] = jaxpr_audit.audit_jaxpr(
+            jax.make_jaxpr(sfn)(*sargs))
+
+    # ---- serving predict buckets (traced, never compiled)
+    from ..serving.predictor import ServingEngine, bucket_sizes
+    from ..serving.registry import ModelRegistry
+    reg = ModelRegistry()
+    reg.register_booster("audit", bst)
+    eng = ServingEngine(registry=reg, max_batch=wl["max_batch"],
+                        min_bucket=wl["min_bucket"])
+    bundle = reg.get("audit")
+    nf = max(bundle.num_features, 1)
+    for bucket in bucket_sizes(eng.min_bucket, eng.max_batch):
+        entry = eng._predictor(bundle, bucket, False,
+                               bundle.effective_iterations(None))
+        trees_sds = jax.tree_util.tree_map(
+            lambda a: sds(a.shape, a.dtype), entry._trees)
+        entries["predict_b%d" % bucket] = jaxpr_audit.audit_jaxpr(
+            jax.make_jaxpr(entry._fn)(
+                trees_sds, sds((bucket, nf), jnp.float32)))
+
+    # ---- donation effectiveness (the one AOT compile of the audit)
+    donation: Dict[str, Any] = {}
+    if block > 0 and getattr(b, "_iter_capture", None) is not None:
+        donation["train_block"] = hlo_audit.audit_donation(
+            b._build_run_block(), b.train_block_sds(block),
+            type(b).TRAIN_BLOCK_DONATE)
+        # the alias table is the contract; HLO text is not baselined
+        donation["train_block"].pop("aliases", None)
+
+    import jax as _jax
+    return {"schema": SCHEMA, "jax": _jax.__version__,
+            "backend": _jax.default_backend(), "workload": wl,
+            "entries": entries, "donation": donation}
+
+
+# ------------------------------------------------------------ comparison
+# per-entry fields compared exactly against the baseline
+_EXACT_FIELDS = ("fingerprint", "num_eqns", "psums", "all_gathers",
+                 "collectives", "collective_schedule")
+
+
+def compare_audit(baseline: Dict[str, Any], measured: Dict[str, Any]
+                  ) -> Tuple[List[Dict[str, Any]], str]:
+    """Violations + human-readable report.  Empty violations == gate
+    passes.  Every violation names the entry point and the invariant."""
+    violations: List[Dict[str, Any]] = []
+    lines: List[str] = []
+
+    def viol(entry: str, invariant: str, base: Any, meas: Any,
+             reason: str) -> None:
+        violations.append({"entry": entry, "invariant": invariant,
+                           "baseline": base, "measured": meas,
+                           "reason": reason})
+
+    base_entries = baseline.get("entries", {})
+    meas_entries = measured.get("entries", {})
+    for name in sorted(set(base_entries) | set(meas_entries)):
+        be, me = base_entries.get(name), meas_entries.get(name)
+        if me is None:
+            viol(name, "present", "present", "missing",
+                 "baselined entry no longer audited")
+            lines.append("%-18s MISSING from measurement" % name)
+            continue
+        # hard invariants first: they hold even without a baseline
+        if me.get("f64_eqns", 0) != 0:
+            viol(name, "zero_f64", 0, me["f64_eqns"],
+                 "f64 primitives on an f32-only entry")
+        if me.get("host_callbacks"):
+            viol(name, "no_host_callbacks", [], me["host_callbacks"],
+                 "host callbacks in a hot-path entry")
+        if be is None:
+            lines.append("%-18s NEW (not in baseline): psums=%d fp=%s"
+                         % (name, me.get("psums", 0),
+                            me.get("fingerprint", "")[:12]))
+            continue
+        ok = True
+        for field in _EXACT_FIELDS:
+            if be.get(field) != me.get(field):
+                invariant = ("collective_schedule"
+                             if field == "collective_schedule" else field)
+                viol(name, invariant, be.get(field), me.get(field),
+                     "%s drift" % field)
+                ok = False
+        lines.append("%-18s %s psums=%d collectives=%d fp=%s"
+                     % (name, "ok  " if ok else "FAIL",
+                        me.get("psums", 0), me.get("collectives", 0),
+                        me.get("fingerprint", "")[:12]))
+
+    base_don = baseline.get("donation", {})
+    meas_don = measured.get("donation", {})
+    for name in sorted(set(base_don) | set(meas_don)):
+        md = meas_don.get(name)
+        if md is None:
+            viol(name, "donation_present", "present", "missing",
+                 "baselined donation record no longer audited")
+            continue
+        if not md.get("ok", False):
+            viol(name, "donation_aliased",
+                 base_don.get(name, {}).get("donated_params"),
+                 md.get("missing"),
+                 "declared donated buffers not input-output aliased")
+        bd = base_don.get(name)
+        if bd is not None and bd.get("donated_params") \
+                != md.get("donated_params"):
+            viol(name, "donation_declaration", bd.get("donated_params"),
+                 md.get("donated_params"), "donate_argnums drift")
+        lines.append("%-18s donation %s params=%s"
+                     % (name, "ok  " if md.get("ok") else "FAIL",
+                        md.get("donated_params")))
+
+    return violations, "\n".join(lines)
+
+
+def publish(measured: Dict[str, Any],
+            violations: List[Dict[str, Any]], registry=None) -> None:
+    """Land the audit outcome as ``lgbm_analysis_*`` registry gauges so
+    the stats server / prometheus scrape sees the last audit state."""
+    from ..obs.registry import get_registry
+    reg = registry if registry is not None else get_registry()
+    entries = measured.get("entries", {})
+    reg.gauge("lgbm_analysis_entries",
+              "entry points audited").set(float(len(entries)))
+    reg.gauge("lgbm_analysis_violations",
+              "invariant violations in the last audit").set(
+        float(len(violations)))
+    reg.gauge("lgbm_analysis_collectives_total",
+              "collective equations across audited entries").set(
+        float(sum(e.get("collectives", 0) for e in entries.values())))
+    reg.gauge("lgbm_analysis_f64_eqns_total",
+              "f64-producing equations across audited entries").set(
+        float(sum(e.get("f64_eqns", 0) for e in entries.values())))
+
+
+# ------------------------------------------------------------ baseline IO
+def default_baseline_path() -> str:
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, BASELINE_NAME)
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, Any]:
+    with open(path or default_baseline_path(), encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_baseline(measured: Dict[str, Any],
+                   path: Optional[str] = None) -> str:
+    """Refuse to baseline a state that breaks the HARD invariants —
+    a baseline must never grandfather f64 or a dropped donation in."""
+    for name, e in measured.get("entries", {}).items():
+        if e.get("f64_eqns", 0) != 0:
+            raise ValueError("refusing to baseline %s: f64 equations "
+                             "present" % name)
+        if e.get("host_callbacks"):
+            raise ValueError("refusing to baseline %s: host callbacks "
+                             "present" % name)
+    for name, d in measured.get("donation", {}).items():
+        if not d.get("ok", False):
+            raise ValueError("refusing to baseline %s: donation not "
+                             "aliased" % name)
+    path = path or default_baseline_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(measured, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path

@@ -225,6 +225,7 @@ class GBDT:
             # trace time. Process-wide, explicit user opt-in.
             Log.info("gpu_use_dp=true: enabling jax x64 mode for "
                      "double-precision histogram accumulation")
+            # lgbm-lint: disable=LGL105 explicit gpu_use_dp user opt-in
             jax.config.update("jax_enable_x64", True)
         self.train_data = train_data
         self.objective = objective
@@ -1061,8 +1062,19 @@ class GBDT:
         self._iter_core = run_iter   # unjitted: train_many scans over it
         return jax.jit(run_iter)
 
-    def _make_train_block_fn(self) -> Callable:
-        """Fuse ``block`` boosting iterations into ONE device program
+    # the block's threaded train-state buffers by run_block position:
+    # scores [N, K] and the bagging mask [N].  One declaration, three
+    # consumers: the executing jit below, the donation audit
+    # (analysis/hlo_audit.py) and its regression test.
+    TRAIN_BLOCK_DONATE = (3, 8)
+
+    def _build_run_block(self) -> Callable:
+        """The unjitted fused-block callable — separated from
+        ``_make_train_block_fn`` so the donation audit can re-jit it
+        with explicit ``donate_argnums`` on any backend without
+        touching the executing program.
+
+        Fuses ``block`` boosting iterations into ONE device program
         (lax.scan over the single-iteration core). The whole boosting loop
         — gradients, bagging refresh, GOSS sampling, tree growth, score
         update — runs on device with no host round trips; trees come back
@@ -1111,14 +1123,54 @@ class GBDT:
             return packs, healths, new_scores, bag_mask, cegb_out, \
                 stopped_out
 
-        # donate the block's threaded train-state buffers (scores [N, K]
-        # and the bagging mask [N]) — both are rebound to the block's
-        # outputs by the caller, so XLA may alias the output into the
-        # input allocation instead of holding both live. CPU has no
-        # donation support and would warn per compile, so gate on backend.
-        donate = ((3, 8) if cfg.tpu_donate_buffers
+        return run_block
+
+    def _make_train_block_fn(self) -> Callable:
+        """The executing fused-block jit (see ``_build_run_block``)."""
+        run_block = self._build_run_block()
+        # donate the threaded train-state buffers (TRAIN_BLOCK_DONATE) —
+        # both are rebound to the block's outputs by the caller, so XLA
+        # may alias the output into the input allocation instead of
+        # holding both live. CPU has no donation support and would warn
+        # per compile, so gate on backend.
+        donate = (self.TRAIN_BLOCK_DONATE
+                  if self.config.tpu_donate_buffers
                   and jax.default_backend() != "cpu" else ())
         return jax.jit(run_block, donate_argnums=donate)
+
+    def train_block_sds(self, block: int) -> Tuple[Any, ...]:
+        """``jax.ShapeDtypeStruct`` mirrors of one ``run_block`` call at
+        ``block`` fused iterations — the exact argument signature the
+        executing program was compiled with.  Shared by cost-model
+        extraction and the donation audit so the audited program IS the
+        dispatched one (never a near-miss signature that would compile a
+        second specialization)."""
+        sds = jax.ShapeDtypeStruct
+
+        def _mirror_leaf(a):
+            if not hasattr(a, "shape") or not hasattr(a, "dtype"):
+                return a
+            try:
+                return sds(a.shape, a.dtype,
+                           sharding=getattr(a, "sharding", None))
+            except Exception:  # noqa: BLE001 - sharding kwarg is optional
+                return sds(a.shape, a.dtype)
+
+        mirror = lambda tree: jax.tree_util.tree_map(_mirror_leaf, tree)  # noqa: E731
+        f = self.train_data.num_features
+        fpad = getattr(self, "_feature_pad", 0)
+        key_arr = jnp.asarray(self._bag_key)
+        return tuple(mirror(self._iter_capture)) + (
+            mirror(self.scores),
+            sds((block, f + fpad), jnp.bool_),      # feature_masks
+            sds((block,), jnp.float32),             # goss_actives
+            sds((block,), jnp.int32),               # iter_idxs
+            sds((block,) + tuple(key_arr.shape), key_arr.dtype),
+            mirror(self._bag_mask),
+            mirror(self._cegb_state),
+            mirror(self._stopped_dev),
+            sds((), jnp.float32),                   # lr
+        )
 
     def warmup_wave_ladder(self) -> Dict[str, Any]:
         """Pre-compile ``build_histogram_frontier`` at every wave-width
@@ -1155,6 +1207,7 @@ class GBDT:
         per_bucket: Dict[int, int] = {}
         for w in widths:
             c0 = backend_compile_count()
+            # lgbm-lint: disable=LGL103 warmup probe, sync is the point
             jax.block_until_ready(build_histogram_frontier(
                 self.xb, slot, g, h, mask, num_bins=params.num_bins,
                 num_slots=w, row_chunk=params.row_chunk,
@@ -1205,39 +1258,14 @@ class GBDT:
             return {}
         from ..obs.costmodel import get_cost_model
         cm = get_cost_model()
-        sds = jax.ShapeDtypeStruct
-
-        def _mirror_leaf(a):
-            if not hasattr(a, "shape") or not hasattr(a, "dtype"):
-                return a
-            try:
-                return sds(a.shape, a.dtype,
-                           sharding=getattr(a, "sharding", None))
-            except Exception:  # noqa: BLE001 - sharding kwarg is optional
-                return sds(a.shape, a.dtype)
-
-        def mirror(tree):
-            return jax.tree_util.tree_map(_mirror_leaf, tree)
 
         out: Dict[str, Dict[str, float]] = {}
         block = int(getattr(self, "_last_block_len", 0) or 0)
         if self._compiled_block is not None and block > 0 \
                 and getattr(self, "_iter_capture", None) is not None:
-            f = self.train_data.num_features
-            fpad = getattr(self, "_feature_pad", 0)
-            key_arr = jnp.asarray(self._bag_key)
             out["train_block"] = cm.analyze(
                 "train_block", self._compiled_block,
-                *mirror(self._iter_capture),
-                mirror(self.scores),
-                sds((block, f + fpad), jnp.bool_),      # feature_masks
-                sds((block,), jnp.float32),             # goss_actives
-                sds((block,), jnp.int32),               # iter_idxs
-                sds((block,) + tuple(key_arr.shape), key_arr.dtype),
-                mirror(self._bag_mask),
-                mirror(self._cegb_state),
-                mirror(self._stopped_dev),
-                sds((), jnp.float32),                   # lr
+                *self.train_block_sds(block),
                 extra_key="block=%d" % block)
         params = self.grow_params
         if getattr(params, "frontier_mode", False) and self.mesh is None:
@@ -1325,7 +1353,7 @@ class GBDT:
                     # one sync at span close; basic mode's only added
                     # barrier, and the block boundary already is one for
                     # the flush cadence
-                    jax.block_until_ready(self.scores)
+                    jax.block_until_ready(self.scores)  # lgbm-lint: disable=LGL103 span close
             self._pending.append({"packed": packs,
                                   "shrinkage": self.shrinkage_rate,
                                   "count": block})
@@ -1549,7 +1577,7 @@ class GBDT:
                 # span-close sync: the per-iteration path is already the
                 # slow (full/host-logic) path, so one barrier per
                 # iteration is the accepted cost of true spans
-                jax.block_until_ready(new_scores)
+                jax.block_until_ready(new_scores)  # lgbm-lint: disable=LGL103 span close
         self.scores = new_scores
         self._cegb_state = cegb_new
 

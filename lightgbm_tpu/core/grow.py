@@ -462,6 +462,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     bf = params.num_feat_bins or b      # per-feature bin axis (split search)
     sp = params.split
     # histogram accumulation dtype (f64 = reference gpu_use_dp semantics)
+    # lgbm-lint: disable=LGL105 gated gpu_use_dp fallback, f32 default
     hdt = jnp.float64 if params.hist_dtype == "f64" else jnp.float32
 
     fp_mode = fp is not None and axis_name is not None
@@ -1070,6 +1071,7 @@ def grow_tree(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # the model contract is f32 tree arrays regardless of the histogram
     # accumulation dtype (the reference also stores float leaf values)
     tree_out = jax.tree.map(
+        # lgbm-lint: disable=LGL105 downcast guard: removes f64, never adds
         lambda a: a.astype(jnp.float32) if a.dtype == jnp.float64 else a,
         state.tree)
     return tree_out, leaf_id_out, state.cegb

@@ -247,7 +247,7 @@ def fix_histogram(hist: jnp.ndarray, default_bins: jnp.ndarray,
     hist: [F, B, 3]; default_bins: [F] int32; sums: scalars.
     """
     f, b, _ = hist.shape
-    arange_b = jnp.arange(b)[None, :]
+    arange_b = jnp.arange(b, dtype=jnp.int32)[None, :]
     is_default = arange_b == default_bins[:, None]  # [F, B]
     totals = jnp.stack([sum_grad, sum_hess, count])  # [3]
     sum_wo_default = jnp.sum(jnp.where(is_default[..., None], 0.0, hist), axis=1)

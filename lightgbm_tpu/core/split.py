@@ -417,7 +417,7 @@ def per_feature_split_categorical(
             pg = jnp.cumsum(gs)
             ph = jnp.cumsum(hs) + K_EPSILON
             pc = jnp.cumsum(cs)
-            i = jnp.arange(b)
+            i = jnp.arange(b, dtype=jnp.int32)
             in_range = (i < max_num_cat) & (i < n_elig)
             left_ok = (pc >= sp.min_data_in_leaf) \
                 & (ph >= sp.min_sum_hessian_in_leaf)

@@ -63,59 +63,23 @@ def default_spec(name: str) -> Dict[str, Any]:
 
 # ------------------------------------------------------------ measurement
 def _psum_per_wave() -> Optional[float]:
-    """Per-wave collective count of the sharded frontier grower, read
-    from the jaxpr string under an 8-device mesh (the pattern pinned by
-    tests/test_obs.py). None when fewer than 8 devices exist — the gate
-    CLI re-execs itself with a virtual-device flag to guarantee them."""
+    """Per-wave collective count of the sharded frontier grower under
+    the 8-device mesh — the shared analysis/jaxpr_audit.py entry and
+    equation walk (one construction; the audit baseline and
+    tests/test_obs.py pin the same program). None when fewer than 8
+    devices exist — the gate CLI re-execs itself with a virtual-device
+    flag to guarantee them."""
     import jax
-    if len(jax.devices()) < 8:
+
+    from ..analysis import jaxpr_audit
+
+    entry = jaxpr_audit.sharded_frontier_fn()
+    if entry is None:
         return None
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    from ..compat import shard_map
-    from ..core.grow import GrowParams
-    from ..core.grow_frontier import grow_tree_frontier
-    from ..core.split import FeatureMeta, SplitParams
-
-    r = np.random.RandomState(0)
-    n, f, b = 256, 4, 16
-    xb = r.randint(0, b, (n, f)).astype(np.uint8)
-    g = r.randn(n).astype(np.float32)
-    ones = np.ones(n, np.float32)
-    meta = FeatureMeta(
-        num_bin=jnp.full((f,), b, jnp.int32),
-        missing_type=jnp.zeros((f,), jnp.int32),
-        default_bin=jnp.zeros((f,), jnp.int32),
-        is_categorical=jnp.zeros((f,), bool),
-        penalty=jnp.ones((f,), jnp.float32),
-        monotone=jnp.zeros((f,), jnp.int32))
-    sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, max_delta_step=0.0,
-                     min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
-                     min_gain_to_split=0.0, max_cat_threshold=32,
-                     cat_smooth=10.0, cat_l2=10.0, max_cat_to_onehot=4,
-                     min_data_per_group=100)
-    params = GrowParams(num_leaves=7, num_bins=b, max_depth=3, split=sp,
-                        row_chunk=16384, hist_impl="scatter")
-    fmask = jnp.ones((f,), bool)
-    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
-
-    def inner(xbj, gj, hj, mj):
-        return grow_tree_frontier(xbj, gj, hj, mj, meta, fmask, params,
-                                  axis_name="data")
-
-    shapes = jax.eval_shape(
-        lambda: grow_tree_frontier(jnp.asarray(xb), jnp.asarray(g),
-                                   jnp.asarray(ones), jnp.asarray(ones),
-                                   meta, fmask, params))
-    out_specs = jax.tree.map(lambda _: P(), shapes)
-    out_specs = (out_specs[0], P("data"), out_specs[2])
-    fn = shard_map(inner, mesh=mesh, in_specs=(P("data"),) * 4,
-                   out_specs=out_specs)
-    jaxpr = str(jax.make_jaxpr(fn)(xb, g, ones, ones))
+    fn, args, params = entry
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    total = jaxpr_audit.count_collectives(jaxpr).get("psum", 0)
     waves = len(bucketing_ladder(params.num_leaves, params.max_depth))
-    total = jaxpr.count("psum")
     # normalize by ladder width count so the counter reads "collectives
     # per compiled wave branch", stable under ladder changes
     return float(total) / max(waves, 1)

@@ -86,7 +86,7 @@ class _Span:
         if self._sync is not None:
             try:
                 import jax
-                jax.block_until_ready(self._sync)
+                jax.block_until_ready(self._sync)  # lgbm-lint: disable=LGL103 span close
             except Exception:
                 pass
         self.duration_s = time.perf_counter() - self._t0

@@ -219,6 +219,7 @@ class ServingEngine:
                     for ni in num_iterations:
                         iters = bundle.effective_iterations(ni)
                         entry = self._predictor(bundle, b, raw, iters)
+                        # lgbm-lint: disable=LGL103 serving warmup sync
                         jax.block_until_ready(entry(zeros))
                         warmed += 1
                         if cm is not None:

@@ -1,0 +1,13 @@
+"""Corpus: a file-level suppression silences a rule everywhere."""
+# lgbm-lint: disable-file=LGL103 benchmark helper, syncs are the point
+import jax
+
+
+def timed_a(x):
+    jax.block_until_ready(x)
+    return x
+
+
+def timed_b(x):
+    jax.block_until_ready(x)
+    return x

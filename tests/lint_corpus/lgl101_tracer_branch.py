@@ -1,0 +1,52 @@
+"""Corpus: LGL101 tracer-unsafe branch.  `# EXPECT=RULE` marks the
+exact line each rule must fire on; tests/test_analysis.py parses the
+markers and asserts the finding set matches them exactly."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_branch(x):
+    y = jnp.abs(x)
+    if y > 0:  # EXPECT=LGL101
+        return x * 2.0
+    return x
+
+
+@jax.jit
+def bad_while(x):
+    s = x.sum()
+    while s > 1.0:  # EXPECT=LGL101
+        s = s / 2.0
+    return s
+
+
+@jax.jit
+def suppressed_branch(x):
+    y = jnp.abs(x)
+    # lgbm-lint: disable=LGL101 demonstrating the suppression channel
+    if y > 0:
+        return y
+    return x
+
+
+@jax.jit
+def static_dispatch_ok(x, impl="scatter", row_chunk=1024):
+    # static python params: none of these may fire (the histogram.py
+    # false-positive class the array-evidence pass exists for)
+    if impl == "scatter":
+        x = x * 2.0
+    n = x.shape[0]
+    pad = row_chunk - n
+    if pad:
+        x = x + 1.0
+    if n <= row_chunk:
+        x = x - 1.0
+    return x
+
+
+def host_fn(x):
+    # not traced: branching on data here is ordinary python
+    if x > 0:
+        return 1
+    return 0

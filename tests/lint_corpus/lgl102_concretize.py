@@ -1,0 +1,27 @@
+"""Corpus: LGL102 tracer concretization inside jit-traced code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_float(x):
+    y = x.sum()
+    return float(y)  # EXPECT=LGL102
+
+
+@jax.jit
+def bad_item(x):
+    y = jnp.max(x)
+    return y.item()  # EXPECT=LGL102
+
+
+def inner_lambda_bad(xs):
+    # the lambda is traced by scan; float() inside it concretizes
+    return jax.lax.scan(
+        lambda c, x: (c + float(x), c),  # EXPECT=LGL102
+        0.0, xs)
+
+
+def host_ok(arr):
+    # host-side float() of host data is fine
+    return float(arr[0])

@@ -1,0 +1,19 @@
+"""Corpus: LGL103 host syncs outside approved, suppressed sites."""
+import jax
+
+
+def hot_loop(fn, xs):
+    out = None
+    for x in xs:
+        out = fn(x)
+        jax.block_until_ready(out)  # EXPECT=LGL103
+    return out
+
+
+def fetch(x):
+    return jax.device_get(x)  # EXPECT=LGL103
+
+
+def span_close(x):
+    jax.block_until_ready(x)  # lgbm-lint: disable=LGL103 span close site
+    return x

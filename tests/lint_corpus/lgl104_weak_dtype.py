@@ -1,0 +1,22 @@
+"""Corpus: LGL104 dtype-less jnp construction in jit-traced code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_arange(n_static):
+    idx = jnp.arange(8)  # EXPECT=LGL104
+    z = jnp.zeros((8,))  # EXPECT=LGL104
+    return idx + z
+
+
+@jax.jit
+def explicit_ok(x):
+    idx = jnp.arange(8, dtype=jnp.int32)
+    z = jnp.zeros((8,), jnp.float32)
+    return x + idx + z
+
+
+def host_side_ok():
+    # not traced: weak dtype here never recompiles a device program
+    return jnp.arange(8)

@@ -1,0 +1,26 @@
+"""Corpus: LGL105 f64-producing constructs on the device path."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_cast(x):
+    return x.astype(jnp.float64)  # EXPECT=LGL105
+
+
+def bad_dtype_string(n):
+    return jnp.zeros((n,), dtype="float64")  # EXPECT=LGL105
+
+
+def bad_x64_flip():
+    jax.config.update("jax_enable_x64", True)  # EXPECT=LGL105
+
+
+def gated_fallback(x):
+    # lgbm-lint: disable=LGL105 explicit double-precision opt-in
+    return x.astype(jnp.float64)
+
+
+def host_ok(a):
+    # host-side numpy f64 never lowers to a device program
+    return np.float64(a)

@@ -1,0 +1,23 @@
+"""Corpus: LGL106 module-global mutation inside jit-traced code."""
+import jax
+
+_CALLS = 0
+_CACHE = {}
+
+
+@jax.jit
+def bad_global(x):
+    global _CALLS  # EXPECT=LGL106
+    _CALLS = _CALLS + 1  # EXPECT=LGL106
+    return x
+
+
+@jax.jit
+def bad_container(x):
+    _CACHE["last"] = x  # EXPECT=LGL106
+    return x
+
+
+def host_ok(x):
+    _CACHE["host"] = x
+    return x

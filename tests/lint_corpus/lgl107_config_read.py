@@ -1,0 +1,19 @@
+"""Corpus: LGL107 config parameter reads config.py does not declare."""
+
+
+def typo_read(cfg):
+    return cfg.learning_rte  # EXPECT=LGL107
+
+
+def declared_ok(cfg):
+    return cfg.learning_rate
+
+
+def alias_ok(cfg):
+    # aliases resolve through the canonical table
+    return cfg.num_leaves
+
+
+def method_ok(config):
+    # method access on a config object is not a parameter read
+    return config.update()

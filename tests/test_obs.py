@@ -236,57 +236,20 @@ def test_registry_thread_safety_under_micro_batch_queue():
 # ------------------------------------------------------- psum invariance
 def test_frontier_health_adds_no_collectives():
     """Acceptance: the per-wave psum count is UNCHANGED with the health
-    accumulator on — health rides values the wave already reduced."""
+    accumulator on — health rides values the wave already reduced.
+    Entry construction and equation walk are the shared
+    analysis/jaxpr_audit.py implementation (the one the audit baseline
+    and perf gate also consume), not a hand-rolled jaxpr scan."""
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
-    from lightgbm_tpu.compat import shard_map
-    from lightgbm_tpu.core.grow import GrowParams
-    from lightgbm_tpu.core.grow_frontier import grow_tree_frontier
-    from lightgbm_tpu.core.split import SplitParams, FeatureMeta
+    from lightgbm_tpu.analysis import jaxpr_audit
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual CPU mesh")
 
-    r = np.random.RandomState(0)
-    n, f, b = 256, 4, 16
-    xb = r.randint(0, b, (n, f)).astype(np.uint8)
-    g = r.randn(n).astype(np.float32)
-    h = np.ones(n, np.float32)
-    ones = np.ones(n, np.float32)
-    meta = FeatureMeta(
-        num_bin=jnp.full((f,), b, jnp.int32),
-        missing_type=jnp.zeros((f,), jnp.int32),
-        default_bin=jnp.zeros((f,), jnp.int32),
-        is_categorical=jnp.zeros((f,), bool),
-        penalty=jnp.ones((f,), jnp.float32),
-        monotone=jnp.zeros((f,), jnp.int32))
-    sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, max_delta_step=0.0,
-                     min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
-                     min_gain_to_split=0.0, max_cat_threshold=32,
-                     cat_smooth=10.0, cat_l2=10.0, max_cat_to_onehot=4,
-                     min_data_per_group=100)
-    fmask = jnp.ones((f,), bool)
-    mesh = Mesh(np.asarray(jax.devices()), ("data",))
-
     def psum_count(obs_health):
-        params = GrowParams(num_leaves=7, num_bins=b, max_depth=3, split=sp,
-                            row_chunk=16384, hist_impl="scatter",
-                            obs_health=obs_health)
-
-        def inner(xbj, gj, hj, mj):
-            return grow_tree_frontier(xbj, gj, hj, mj, meta, fmask, params,
-                                      axis_name="data")
-
-        shapes = jax.eval_shape(
-            lambda: grow_tree_frontier(jnp.asarray(xb), jnp.asarray(g),
-                                       jnp.asarray(h), jnp.asarray(ones),
-                                       meta, fmask, params))
-        out_specs = jax.tree.map(lambda _: P(), shapes)
-        # only the per-row leaf ids stay sharded
-        out_specs = (out_specs[0], P("data"), out_specs[2])
-        fn = shard_map(inner, mesh=mesh,
-                       in_specs=(P("data"),) * 4, out_specs=out_specs)
-        return str(jax.make_jaxpr(fn)(xb, g, h, ones)).count("psum")
+        fn, args, _ = jaxpr_audit.sharded_frontier_fn(
+            param_overrides={"obs_health": obs_health})
+        counts = jaxpr_audit.count_collectives(jax.make_jaxpr(fn)(*args))
+        return counts.get("psum", 0)
 
     n_off = psum_count(False)
     n_on = psum_count(True)

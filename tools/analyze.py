@@ -1,0 +1,133 @@
+"""Static-analysis gate (tier1 CI): JAX-aware lint + compiled-program
+audit (lightgbm_tpu/analysis/).
+
+``--lint`` runs the AST lint (astlint.py rule catalog LGL101-LGL107)
+over the package source; any unsuppressed finding fails the gate.
+``--audit`` lowers every hot entry point (fused train block, each
+wave-width ladder bucket, materialize, the sharded grower under the
+8-virtual-device mesh, serving predict buckets) and verifies the
+committed ``ANALYSIS_BASELINE.json`` invariants: jaxpr structural
+fingerprints, exact collective schedules, zero f64 primitives, zero
+host callbacks, and train-block donation effectiveness.  With neither
+flag, both run.
+
+Exit 0 = clean; 1 = findings/violations, each naming the file+rule or
+entry+invariant.  Intentional program changes re-baseline with
+``--write-baseline`` and commit the result (docs/StaticAnalysis.md
+documents the workflow; the baseline writer refuses states that break
+the hard invariants).
+
+Re-execs itself once with ``JAX_PLATFORMS=cpu`` and an 8-virtual-device
+``XLA_FLAGS`` so the sharded-grower collective schedule can be audited
+anywhere — both must be set before jax first imports.
+"""
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)   # repo root for lightgbm_tpu
+
+_REEXEC_FLAG = "_LGBM_ANALYZE_CHILD"
+_VDEV_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _reexec_with_virtual_devices() -> None:
+    """The audit must be platform-pinned and see 8 devices; both env
+    vars only take effect before jax's first import, hence the re-exec."""
+    if os.environ.get(_REEXEC_FLAG) == "1":
+        return
+    env = dict(os.environ)
+    env[_REEXEC_FLAG] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if _VDEV_FLAG not in flags:
+        env["XLA_FLAGS"] = (flags + " " + _VDEV_FLAG).strip()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _run_lint(report: dict) -> int:
+    from lightgbm_tpu.analysis import astlint
+    findings = astlint.lint_package()
+    report["lint"] = {"findings": [vars(f) for f in findings]}
+    for f in findings:
+        print(f.format())
+    if findings:
+        print("analyze: %d lint finding(s) — fix or suppress with "
+              "`# lgbm-lint: disable=<RULE> <reason>`" % len(findings),
+              file=sys.stderr)
+        return 1
+    print("analyze: lint clean (%d rules)" % len(astlint.LINT_RULES))
+    return 0
+
+
+def _run_audit(report: dict, baseline_path: str,
+               write_baseline: bool) -> int:
+    from lightgbm_tpu.analysis import auditor
+    measured = auditor.collect_audit()
+    report["audit"] = {"measured": measured}
+
+    if write_baseline:
+        path = auditor.write_baseline(measured, baseline_path)
+        print("wrote %s (%d entries)" % (path, len(measured["entries"])))
+        return 0
+
+    if not os.path.exists(baseline_path):
+        print("analyze: no baseline at %s — run with --write-baseline "
+              "and commit it" % baseline_path, file=sys.stderr)
+        return 1
+    baseline = auditor.load_baseline(baseline_path)
+    violations, table = auditor.compare_audit(baseline, measured)
+    auditor.publish(measured, violations)
+    report["audit"]["violations"] = violations
+    print(table)
+    if violations:
+        print("analyze: %d audit violation(s):" % len(violations),
+              file=sys.stderr)
+        for v in violations:
+            print("  %(entry)s / %(invariant)s: baseline=%(baseline)s "
+                  "measured=%(measured)s (%(reason)s)" % v,
+                  file=sys.stderr)
+        return 1
+    print("analyze: all %d audited entries match the baseline."
+          % len(measured["entries"]))
+    return 0
+
+
+def main() -> int:
+    _reexec_with_virtual_devices()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--lint", action="store_true",
+                    help="run the AST lint over the package source")
+    ap.add_argument("--audit", action="store_true",
+                    help="run the jaxpr/HLO audit against the baseline")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "ANALYSIS_BASELINE.json"),
+                    help="committed audit baseline to gate against / write")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="measure and (re)write the audit baseline, "
+                         "no gating")
+    ap.add_argument("--out", default="",
+                    help="also write the findings/violations report "
+                         "JSON here (CI artifact)")
+    args = ap.parse_args()
+    do_lint = args.lint or not (args.lint or args.audit)
+    do_audit = args.audit or not (args.lint or args.audit)
+
+    report: dict = {}
+    rc = 0
+    if do_lint:
+        rc |= _run_lint(report)
+    if do_audit:
+        rc |= _run_audit(report, args.baseline, args.write_baseline)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
