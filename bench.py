@@ -308,12 +308,32 @@ def run_bench(backend_info: dict) -> dict:
                 "predict_rows_per_sec": round(rows * reps / dt_s, 1),
                 "serve_recompiles_after_warmup":
                     eng.metrics.recompiles_after_warmup(),
+                # per-bucket device-call latency quantiles from the
+                # serving histograms (obs Histogram.quantile) — the SLO
+                # numbers tools/load_test.py gates on
+                "predict_latency_by_bucket": eng.metrics.bucket_latency(),
                 # the timed window's bucket + dispatch count, for the
                 # roofline join (rows chunk at max_batch, padded pow-2)
                 "_predict_bucket": min(eng.max_batch, max(
                     eng.min_bucket, 1 << (rows - 1).bit_length())),
                 "_predict_wall": (dt_s, float(reps * chunks)),
             }
+            # traversal-vs-replay A/B on the same model + batch: the
+            # replay engine re-runs every tree's O(num_leaves) node
+            # replay, the default engine above traversed O(depth) SoA
+            # levels — the speedup is the tentpole's headline number
+            if os.environ.get("BENCH_SERVE_AB", "1") != "0":
+                rb = ServingEngine(max_batch=eng.max_batch,
+                                   backend="replay")
+                rb.registry.register_impl("bench", b)
+                rb.warmup(raw_scores=(True,))
+                t0 = time.time()
+                rb.predict("bench", X[:rows], raw_score=True)
+                dt_r = time.time() - t0
+                serve["predict_rows_per_sec_replay"] = round(rows / dt_r, 1)
+                serve["traversal_speedup_vs_replay"] = round(
+                    serve["predict_rows_per_sec"]
+                    / max(serve["predict_rows_per_sec_replay"], 1e-9), 2)
         except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
             serve = {"predict_error": repr(e)[:200]}
     phases = {}

@@ -20,7 +20,10 @@ Entries audited:
                          fingerprint PR 6 pinned as a string compare)
 - ``grower_sharded``     the 8-virtual-device shard_map grower (the
                          psum schedule PR 5 pinned by hand)
-- ``predict_b<bucket>``  every serving bucket's forward pass
+- ``predict_b<bucket>``  every serving bucket's forward pass (the SoA
+                         traversal — serving/traversal.py)
+- ``predict_cascade_b<min_bucket>``  the early-exit cascade variant
+                         (stage-1 prefix + conditional stage 2)
 
 Hard invariants hold regardless of baseline content: zero f64 equations
 and zero host callbacks in every entry, and every declared train-block
@@ -139,6 +142,18 @@ def collect_audit(workload: Optional[Dict[str, Any]] = None
         entries["predict_b%d" % bucket] = jaxpr_audit.audit_jaxpr(
             jax.make_jaxpr(entry._fn)(
                 trees_sds, sds((bucket, nf), jnp.float32)))
+
+    # ---- early-exit cascade variant (stage-1 prefix + lax.cond stage 2)
+    ceng = ServingEngine(registry=reg, max_batch=wl["max_batch"],
+                         min_bucket=wl["min_bucket"],
+                         cascade_trees=1, cascade_margin=2.0)
+    centry = ceng._predictor(bundle, wl["min_bucket"], False,
+                             bundle.effective_iterations(None))
+    ctrees_sds = jax.tree_util.tree_map(
+        lambda a: sds(a.shape, a.dtype), centry._trees)
+    entries["predict_cascade_b%d" % wl["min_bucket"]] = \
+        jaxpr_audit.audit_jaxpr(jax.make_jaxpr(centry._fn)(
+            ctrees_sds, sds((wl["min_bucket"], nf), jnp.float32)))
 
     # ---- donation effectiveness (the one AOT compile of the audit)
     donation: Dict[str, Any] = {}

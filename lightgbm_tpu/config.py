@@ -224,6 +224,12 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("serve_warmup", bool, True, []),         # compile all buckets at boot
     ("serve_metrics_file", str, "", []),      # JSON-lines metrics sink
     ("serve_metrics_freq", float, 10.0, []),  # seconds between snapshots
+    # serving hot path (serving/traversal.py): SoA traversal vs replay,
+    # early-exit cascade, and int16 leaf-table quantization
+    ("serving_backend", str, "traversal", ["serve_backend"]),
+    ("serving_cascade_trees", int, 0, ["serve_cascade_trees"]),
+    ("serving_cascade_margin", float, 10.0, ["serve_cascade_margin"]),
+    ("serving_quantize_leaves", bool, False, ["serve_quantize_leaves"]),
     # ---- observability (lightgbm_tpu.obs; docs/Observability.md) ----
     # none: zero instrumentation (default). basic: fused blocks kept,
     # per-block spans/events/health (<3% overhead, bench-verified).
@@ -249,6 +255,7 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
 # mode must fail loudly at config time, not fall through to some default
 # deep in the dispatch)
 TREE_GROW_MODES = ("exact", "batched", "frontier")
+SERVING_BACKENDS = ("traversal", "replay")
 OBSERVABILITY_LEVELS = ("none", "basic", "full")
 HEALTH_MONITOR_ACTIONS = ("auto", "none", "warn", "abort", "raise")
 HIST_IMPLS = ("auto", "matmul", "scatter", "pallas", "pallas_highest",
@@ -478,6 +485,18 @@ class Config:
         if self.obs_perfetto_start < 0 or self.obs_perfetto_iters < 0:
             raise LightGBMError("obs_perfetto_start/obs_perfetto_iters "
                                 "should be >= 0")
+        self.serving_backend = str(self.serving_backend).strip().lower()
+        if self.serving_backend not in SERVING_BACKENDS:
+            raise LightGBMError("serving_backend should be one of %s, got %s"
+                                % ("/".join(SERVING_BACKENDS),
+                                   self.serving_backend))
+        if self.serving_cascade_trees < 0:
+            raise LightGBMError("serving_cascade_trees should be >= 0 "
+                                "(0 = no cascade), got %s"
+                                % self.serving_cascade_trees)
+        if self.serving_cascade_margin < 0:
+            raise LightGBMError("serving_cascade_margin should be >= 0, "
+                                "got %s" % self.serving_cascade_margin)
         # verbosity drives the process logger unconditionally so
         # verbosity=-1 (fatal-only) also silences obs warnings; previously
         # negative values were dropped and warnings leaked through

@@ -71,11 +71,15 @@ def pack_predict_table(ht, max_nodes: int, max_leaves: int,
     )
 
 
-def _raw_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
-                 default_left: jnp.ndarray, missing_type: jnp.ndarray,
-                 is_cat: jnp.ndarray, cat_bitset: jnp.ndarray) -> jnp.ndarray:
+def decision_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
+                     default_left: jnp.ndarray, missing_type: jnp.ndarray,
+                     is_cat: jnp.ndarray, gather_cat_word,
+                     max_cat: int) -> jnp.ndarray:
     """Tree::NumericalDecision / CategoricalDecision on raw values
-    (tree.h:212-243)."""
+    (tree.h:212-243), shared by the replay path below and the serving
+    SoA traversal (serving/traversal.py) so both make bit-identical
+    routing decisions. ``gather_cat_word(word_index)`` abstracts the
+    bitset lookup — the two callers gather along different axes."""
     is_nan = jnp.isnan(fval)
     # NaN with non-NaN missing handling is treated as 0 (tree.h NumericalDecision)
     fval_safe = jnp.where(is_nan, 0.0, fval)
@@ -84,12 +88,20 @@ def _raw_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
         missing_type == MISSING_NAN, is_nan,
         jnp.where(missing_type == MISSING_ZERO, is_zero | is_nan, False))
     numerical = jnp.where(use_default, default_left, fval_safe <= threshold)
-    max_cat = cat_bitset.shape[0] * 32     # variable-width bitset
     cat_i = jnp.clip(fval_safe, 0, max_cat - 1).astype(jnp.int32)
-    word = cat_bitset[cat_i >> 5]
+    word = gather_cat_word(cat_i >> 5)
     cat_ok = (~is_nan) & (fval >= 0) & (fval < max_cat)
     categorical = cat_ok & (((word >> (cat_i & 31).astype(jnp.uint32)) & 1) == 1)
     return jnp.where(is_cat, categorical, numerical)
+
+
+def _raw_go_left(fval: jnp.ndarray, threshold: jnp.ndarray,
+                 default_left: jnp.ndarray, missing_type: jnp.ndarray,
+                 is_cat: jnp.ndarray, cat_bitset: jnp.ndarray) -> jnp.ndarray:
+    """Replay-path decision: one node's ``[W]`` bitset, rows vectorized."""
+    max_cat = cat_bitset.shape[0] * 32     # variable-width bitset
+    return decision_go_left(fval, threshold, default_left, missing_type,
+                            is_cat, lambda wi: cat_bitset[wi], max_cat)
 
 
 def predict_tree_leaves_raw(tree: PredictTree, x: jnp.ndarray) -> jnp.ndarray:

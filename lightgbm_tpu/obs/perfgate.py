@@ -19,7 +19,11 @@ workload:
 - XLA cost-model FLOPs / bytes per compiled entry point (train block +
   every ladder bucket, obs/costmodel.py) — these DO drift across XLA
   releases, so they carry relative tolerances; everything structural is
-  exact.
+  exact;
+- the serving hot path's fingerprint: the SoA traversal's static depth
+  and bucket ladder (exact) plus per-bucket predict FLOPs / bytes
+  (serving/traversal.py — a regression here is a serving latency
+  regression the wall-clock-free gate can still see).
 
 The committed baseline (PERF_COUNTERS.json) declares every counter with
 its tolerance: ``{"value": v, "tol": t, "mode": "exact"|"rel"}``. A
@@ -153,10 +157,51 @@ def measure(workload: Optional[Dict[str, Any]] = None
         counters["costmodel_bytes_" + name] = float(
             costs[name]["bytes_accessed"])
 
+    counters.update(_serving_counters(bst, int(wl["features"])))
+
     psum = _psum_per_wave()
     if psum is not None:
         counters["psum_per_wave_branch"] = psum
     return counters, wl
+
+
+def _serving_counters(bst, num_features: int) -> Dict[str, Any]:
+    """Serving traversal counters on the gate booster: the static
+    traversal depth and bucket ladder (structural, exact) plus XLA
+    FLOPs / bytes for every bucket's compiled predict — the serving hot
+    path's cost fingerprint (serving/traversal.py). AOT-only: predictors
+    are built but never executed, so nothing here perturbs the
+    compiles_after_warmup counter measured above."""
+    import jax
+
+    from ..serving.predictor import ServingEngine, bucket_sizes
+    from .costmodel import get_cost_model
+
+    eng = ServingEngine(max_batch=64, min_bucket=32)
+    bundle = eng.registry.register_booster("gate", bst)
+    _, depth = bundle.flat_for()
+    counters: Dict[str, Any] = {
+        "predict_traversal_depth": float(depth),
+        "predict_bucket_ladder": [int(b) for b in
+                                  bucket_sizes(eng.min_bucket,
+                                               eng.max_batch)],
+    }
+    cm = get_cost_model()
+    iters = bundle.effective_iterations(None)
+    for bucket in bucket_sizes(eng.min_bucket, eng.max_batch):
+        entry = eng._predictor(bundle, bucket, False, iters)
+        costs = cm.analyze(
+            "perfgate_predict_b%d" % bucket, entry._fn,
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                entry._trees),
+            jax.ShapeDtypeStruct((bucket, num_features), jax.numpy.float32),
+            extra_key="perfgate")
+        counters["costmodel_flops_predict_b%d" % bucket] = \
+            float(costs["flops"])
+        counters["costmodel_bytes_predict_b%d" % bucket] = \
+            float(costs["bytes_accessed"])
+    return counters
 
 
 # ------------------------------------------------------------ baseline IO

@@ -232,6 +232,31 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum
 
+    def quantile(self, q: float) -> float:
+        """Estimate the ``q``-quantile from the bucket counts — the same
+        linear-interpolation-within-the-owning-bucket estimate Prometheus'
+        ``histogram_quantile`` makes (lower edge 0 for the first bucket;
+        observations in the +Inf bucket clamp to the last finite bound).
+        Coarse by construction, but aggregatable — unlike a windowed
+        quantile — which is why serving's per-bucket latency view rides
+        it (docs/Serving.md)."""
+        q = min(max(float(q), 0.0), 1.0)
+        with self._lock:
+            counts = list(self._counts)
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        lo = 0.0
+        for bound, c in zip(self._bounds, counts):
+            if c > 0 and cum + c >= rank:
+                frac = min(max((rank - cum) / c, 0.0), 1.0)
+                return lo + (bound - lo) * frac
+            cum += c
+            lo = bound
+        return self._bounds[-1]
+
     def samples(self):
         with self._lock:
             counts = list(self._counts)

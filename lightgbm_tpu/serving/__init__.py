@@ -4,6 +4,7 @@ The training side of this repo grows trees; this package serves them under
 heavy traffic without ever recompiling after warmup:
 
 - registry.py   model files -> immutable device-resident tree bundles
+- traversal.py  SoA flattened-ensemble traversal (the default hot path)
 - predictor.py  compiled-predictor cache, power-of-two batch bucketing
 - batching.py   deadline-bounded micro-batch coalescing queue
 - server.py     HTTP / stdin front-ends (cli.py task=serve)
@@ -16,12 +17,14 @@ build a ServingEngine and register boosters directly (see docs/Serving.md).
 from .batching import MicroBatchQueue
 from .metrics import ServingMetrics, backend_compile_count, install_compile_hook
 from .predictor import ServingEngine, bucket_rows, bucket_sizes
-from .registry import ModelBundle, ModelRegistry
+from .registry import CheckpointWatcher, ModelBundle, ModelRegistry
 from .server import ServingApp, build_app, make_server, run_server, serve_stdin
+from .traversal import FlatForest, forest_scores_flat, pack_flat_forest
 
 __all__ = [
-    "MicroBatchQueue", "ModelBundle", "ModelRegistry", "ServingApp",
-    "ServingEngine", "ServingMetrics", "backend_compile_count",
-    "bucket_rows", "bucket_sizes", "build_app", "install_compile_hook",
-    "make_server", "run_server", "serve_stdin",
+    "CheckpointWatcher", "FlatForest", "MicroBatchQueue", "ModelBundle",
+    "ModelRegistry", "ServingApp", "ServingEngine", "ServingMetrics",
+    "backend_compile_count", "bucket_rows", "bucket_sizes", "build_app",
+    "forest_scores_flat", "install_compile_hook", "make_server",
+    "pack_flat_forest", "run_server", "serve_stdin",
 ]

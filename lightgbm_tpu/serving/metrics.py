@@ -50,6 +50,10 @@ class ServingMetrics:
     # predict (sub-ms..ms) and a queue-inclusive cold request (seconds)
     LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
                           250.0, 500.0, 1000.0, 2500.0, 5000.0)
+    # device predict latency per shape bucket: finer at the low end —
+    # a warm traversal pass is sub-ms on accelerator, low-ms on CPU
+    PREDICT_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                          50.0, 100.0, 250.0, 1000.0)
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
@@ -58,6 +62,7 @@ class ServingMetrics:
         # per-instance label: each engine/test gets independent series
         # while one scrape of the global registry still sees them all
         lbl = {"sink": "serving-%d" % next(_sink_seq)}
+        self._lbl = dict(lbl)
         self._c_requests = reg.counter(
             "lgbm_serving_requests_total", "Prediction requests served.",
             labels=lbl)
@@ -90,8 +95,11 @@ class ServingMetrics:
             "callers).", labels=lbl, buckets=self.LATENCY_BUCKETS_MS)
         self._lat_window = collections.deque(maxlen=window)
         self._batch_rows = collections.deque(maxlen=window)
+        self._bucket_hist: Dict[int, object] = {}   # bucket -> Histogram
         self._compile_floor = 0          # backend compiles at warmup end
         self._miss_floor = 0             # cache misses at warmup end
+        self._warmup_credit_compiles = 0  # hot-roll prewarm compiles
+        self._warmup_credit_misses = 0
         install_compile_hook()
 
     # ------------------------------------------------------------ views
@@ -138,6 +146,34 @@ class ServingMetrics:
         with self._lock:
             self._batch_rows.append(rows)
 
+    def record_bucket_latency(self, bucket: int, ms: float) -> None:
+        """Device predict latency for one padded forward pass, keyed by
+        its shape bucket (``lgbm_serving_predict_latency_ms`` histogram
+        with a ``bucket`` label; the per-bucket p50/p99 view bench.py
+        reports rides ``bucket_latency()``)."""
+        with self._lock:
+            h = self._bucket_hist.get(bucket)
+            if h is None:
+                lbl = dict(self._lbl)
+                lbl["bucket"] = str(int(bucket))
+                h = get_registry().histogram(
+                    "lgbm_serving_predict_latency_ms",
+                    "Device predict latency per shape bucket "
+                    "(milliseconds, padded forward pass only).",
+                    labels=lbl, buckets=self.PREDICT_BUCKETS_MS)
+                self._bucket_hist[bucket] = h
+        h.observe(ms)
+
+    def bucket_latency(self) -> Dict[str, Dict[str, float]]:
+        """``{bucket: {count, p50_ms, p99_ms}}`` estimated from the
+        per-bucket histogram counts (obs Histogram.quantile)."""
+        with self._lock:
+            hists = sorted(self._bucket_hist.items())
+        return {str(b): {"count": int(h.count),
+                         "p50_ms": round(h.quantile(0.5), 4),
+                         "p99_ms": round(h.quantile(0.99), 4)}
+                for b, h in hists}
+
     def record_cache(self, hit: bool) -> None:
         (self._c_cache_hits if hit else self._c_cache_misses).inc()
 
@@ -153,6 +189,20 @@ class ServingMetrics:
         with self._lock:
             self._compile_floor = backend_compile_count()
             self._miss_floor = self.cache_misses
+            self._warmup_credit_compiles = 0
+            self._warmup_credit_misses = 0
+
+    def add_warmup_credit(self, compiles: int, misses: int) -> None:
+        """Raise the recompile/miss floors for compilations a hot-roll
+        prewarm paid OFF the request path (ServingEngine.prewarm_bundle):
+        they are warmup work for the next model generation, not serving
+        recompiles. Tracked separately so snapshots show how much credit
+        was granted."""
+        with self._lock:
+            self._compile_floor += int(compiles)
+            self._miss_floor += int(misses)
+            self._warmup_credit_compiles += int(compiles)
+            self._warmup_credit_misses += int(misses)
 
     def recompiles_after_warmup(self) -> int:
         with self._lock:
@@ -164,6 +214,7 @@ class ServingMetrics:
 
     # ------------------------------------------------------------ export
     def snapshot(self) -> Dict:
+        by_bucket = self.bucket_latency()
         with self._lock:
             lat = latency_summary(list(self._lat_window))
             rows_per_batch = (float(sum(self._batch_rows))
@@ -182,7 +233,10 @@ class ServingMetrics:
                 "backend_compiles": backend_compile_count(),
                 "recompiles_after_warmup":
                     backend_compile_count() - self._compile_floor,
+                "warmup_credit_compiles": self._warmup_credit_compiles,
+                "warmup_credit_misses": self._warmup_credit_misses,
                 "latency_ms": lat,
+                "predict_latency_ms_by_bucket": by_bucket,
             }
 
     def write_jsonl(self, path_or_fh) -> Dict:

@@ -36,6 +36,8 @@ from ..bucketing import pow2_bucket, pow2_ladder
 from ..core import tree as tree_mod
 from ..log import LightGBMError, check
 from ..parallel.mesh import replicated, row_sharding, serving_mesh
+from ..config import SERVING_BACKENDS
+from . import traversal as traversal_mod
 from .metrics import ServingMetrics
 from .registry import ModelBundle, ModelRegistry
 
@@ -55,12 +57,29 @@ def bucket_sizes(min_bucket: int = 16, max_batch: int = 4096) -> List[int]:
 
 
 class _CompiledPredictor:
-    """One cache entry: a jit function pinned to (trees, bucket, transform)."""
+    """One cache entry: a jit function pinned to (trees, bucket, transform).
+
+    ``backend="traversal"`` (default) serves from the bundle's packed
+    ``FlatForest`` (serving/traversal.py): O(depth) fused gather steps
+    over all rows x all trees instead of the replay path's
+    O(num_leaves) sequential split replays — same bit-exact outputs.
+    ``backend="replay"`` keeps the training-side path (also the
+    fallback for bundles without host-side trees)."""
 
     def __init__(self, bundle: ModelBundle, bucket: int, raw_score: bool,
-                 num_iteration: int, mesh=None):
+                 num_iteration: int, mesh=None, backend: str = "traversal",
+                 cascade_trees: int = 0, cascade_margin: float = 10.0,
+                 quantize_leaves: bool = False):
         self.bucket = bucket
-        trees = bundle.trees_for(num_iteration)
+        use_traversal = (backend == "traversal"
+                         and bundle.host_models is not None)
+        self.backend = "traversal" if use_traversal else "replay"
+        if use_traversal:
+            trees, depth = bundle.flat_for(num_iteration,
+                                           quantize=quantize_leaves)
+        else:
+            trees = bundle.trees_for(num_iteration)
+            depth = 0
         self._mesh = mesh
         # static per-entry dispatch: shard rows when the bucket tiles the
         # mesh evenly, otherwise replicate the batch too (tiny buckets)
@@ -76,9 +95,15 @@ class _CompiledPredictor:
         convert = (None if raw_score or bundle.objective is None
                    else bundle.objective.convert_output)
         avg_iters = num_iteration if bundle.average_output else 0
+        k = bundle.num_tree_per_iteration
 
         def apply(t, x):
-            out = tree_mod.predict_forest_scores(t, x)      # [bucket, K] f32
+            if use_traversal:
+                out = traversal_mod.forest_scores_flat(
+                    t, x, k, depth, cascade_trees=cascade_trees,
+                    cascade_margin=cascade_margin)      # [bucket, K] f32
+            else:
+                out = tree_mod.predict_forest_scores(t, x)
             if avg_iters:
                 out = out / np.float32(avg_iters)
             if convert is not None:
@@ -103,13 +128,25 @@ class ServingEngine:
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  max_batch: int = 4096, min_bucket: int = 16,
                  num_devices: int = 1,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 backend: str = "traversal", cascade_trees: int = 0,
+                 cascade_margin: float = 10.0,
+                 quantize_leaves: bool = False):
         check(max_batch >= 1 and min_bucket >= 1,
               "serve_max_batch and serve_min_bucket must be >= 1")
+        check(backend in SERVING_BACKENDS,
+              "serving_backend should be one of %s, got %r"
+              % (list(SERVING_BACKENDS), backend))
+        check(cascade_trees >= 0 and cascade_margin >= 0,
+              "serving_cascade_trees and serving_cascade_margin must be >= 0")
         # normalize both to powers of two so bucket_rows' ladder is exact
         self.min_bucket = 1 << (int(min_bucket) - 1).bit_length()
         self.max_batch = max(1 << (int(max_batch) - 1).bit_length(),
                              self.min_bucket)
+        self.backend = backend
+        self.cascade_trees = int(cascade_trees)
+        self.cascade_margin = float(cascade_margin)
+        self.quantize_leaves = bool(quantize_leaves)
         self.registry = registry if registry is not None else ModelRegistry()
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.mesh = serving_mesh(num_devices) if num_devices != 1 else None
@@ -121,11 +158,15 @@ class ServingEngine:
 
     # ------------------------------------------------------------ cache
     def _invalidate_model(self, model_id: str) -> None:
-        """Drop every cache entry compiled against a replaced bundle. The
-        generation in the cache key already prevents stale *hits*; this
-        reclaims the dead entries' device memory."""
+        """Drop cache entries compiled against generations OTHER than the
+        model's current one. The generation in the cache key already
+        prevents stale *hits*; this reclaims dead entries' device memory
+        while keeping entries a hot-roll prewarm compiled for the
+        just-committed generation (prewarm_bundle)."""
+        current = self.registry.generation(model_id)
         with self._lock:
-            for key in [k for k in self._cache if k[0] == model_id]:
+            for key in [k for k in self._cache
+                        if k[0] == model_id and k[1] != current]:
                 del self._cache[key]
 
     def _predictor(self, bundle: ModelBundle, bucket: int, raw_score: bool,
@@ -135,8 +176,11 @@ class ServingEngine:
         with self._lock:
             entry = self._cache.get(key)
             if entry is None:
-                entry = _CompiledPredictor(bundle, bucket, raw_score, iters,
-                                           mesh=self.mesh)
+                entry = _CompiledPredictor(
+                    bundle, bucket, raw_score, iters, mesh=self.mesh,
+                    backend=self.backend, cascade_trees=self.cascade_trees,
+                    cascade_margin=self.cascade_margin,
+                    quantize_leaves=self.quantize_leaves)
                 self._cache[key] = entry
                 hit = False
             else:
@@ -178,8 +222,11 @@ class ServingEngine:
                 xpad = np.zeros((b, X.shape[1]), np.float32)
                 xpad[:xc.shape[0]] = xc
             entry = self._predictor(bundle, b, raw_score, iters)
+            t1 = time.perf_counter()
             out = np.asarray(entry(xpad), np.float64)[:xc.shape[0]]
             self.metrics.record_batch(b)
+            self.metrics.record_bucket_latency(
+                b, (time.perf_counter() - t1) * 1000.0)
             outs.append(out)
         out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
         if bundle.num_tree_per_iteration == 1:
@@ -211,25 +258,71 @@ class ServingEngine:
             cm = get_cost_model()
         warmed = 0
         for mid in ids:
-            bundle = self.registry.get(mid)
-            nf = max(bundle.num_features, 1)
-            for b in bucket_sizes(self.min_bucket, self.max_batch):
-                zeros = np.zeros((b, nf), np.float32)
-                for raw in raw_scores:
-                    for ni in num_iterations:
-                        iters = bundle.effective_iterations(ni)
-                        entry = self._predictor(bundle, b, raw, iters)
-                        # lgbm-lint: disable=LGL103 serving warmup sync
-                        jax.block_until_ready(entry(zeros))
-                        warmed += 1
-                        if cm is not None:
-                            cm.analyze(
-                                "predict_b%d" % b, entry._fn,
-                                jax.tree_util.tree_map(
-                                    lambda a: jax.ShapeDtypeStruct(
-                                        a.shape, a.dtype), entry._trees),
-                                jax.ShapeDtypeStruct((b, nf), jnp.float32),
-                                extra_key="model=%s;raw=%d;iters=%d"
-                                % (mid, int(raw), iters))
+            warmed += self._warm_bundle(self.registry.get(mid), raw_scores,
+                                        num_iterations, cm)
         self.metrics.mark_warmup_done()
         return warmed
+
+    def _warm_bundle(self, bundle: ModelBundle, raw_scores, num_iterations,
+                     cm=None) -> int:
+        """Compile + execute every bucket for one bundle (shared by
+        boot-time ``warmup`` and hot-roll ``prewarm_bundle``)."""
+        nf = max(bundle.num_features, 1)
+        warmed = 0
+        for b in bucket_sizes(self.min_bucket, self.max_batch):
+            zeros = np.zeros((b, nf), np.float32)
+            for raw in raw_scores:
+                for ni in num_iterations:
+                    iters = bundle.effective_iterations(ni)
+                    entry = self._predictor(bundle, b, raw, iters)
+                    # lgbm-lint: disable=LGL103 serving warmup sync
+                    jax.block_until_ready(entry(zeros))
+                    warmed += 1
+                    if cm is not None:
+                        cm.analyze(
+                            "predict_b%d" % b, entry._fn,
+                            jax.tree_util.tree_map(
+                                lambda a: jax.ShapeDtypeStruct(
+                                    a.shape, a.dtype), entry._trees),
+                            jax.ShapeDtypeStruct((b, nf), jnp.float32),
+                            extra_key="model=%s;raw=%d;iters=%d"
+                            % (bundle.model_id, int(raw), iters))
+        return warmed
+
+    def prewarm_bundle(self, bundle: ModelBundle,
+                       raw_scores: Iterable[bool] = (False,),
+                       num_iterations: Iterable[Optional[int]] = (None,)
+                       ) -> int:
+        """Compile a STAGED bundle's predictors before it is registered
+        (registry.stage_file -> prewarm_bundle -> register): a hot-roll
+        pays its compilations here, off the request path, and the
+        compiles/misses are credited to the metrics floors so the
+        zero-recompile-after-warmup assertion survives the roll. Entries
+        are cached under the staged generation; the generation-aware
+        purge keeps them when the swap commits."""
+        from ..profiling import backend_compile_count
+        c0 = backend_compile_count()
+        m0 = self.metrics.cache_misses
+        warmed = self._warm_bundle(bundle, raw_scores, num_iterations)
+        self.metrics.add_warmup_credit(backend_compile_count() - c0,
+                                       self.metrics.cache_misses - m0)
+        return warmed
+
+    def stage_and_prewarm(self, model_id: str, path: str,
+                          raw_scores: Iterable[bool] = (False,),
+                          num_iterations: Iterable[Optional[int]] = (None,)
+                          ) -> ModelBundle:
+        """The full off-path half of a hot-roll: stage ``path`` as the
+        next generation of ``model_id`` and prewarm it, crediting EVERY
+        compilation in the window — the staged bundle's device stacking
+        included, not just the predictor compiles — to the warmup
+        floors. Caller commits with ``registry.register(bundle,
+        replace=True)`` (CheckpointWatcher.poll does exactly this)."""
+        from ..profiling import backend_compile_count
+        c0 = backend_compile_count()
+        m0 = self.metrics.cache_misses
+        bundle = self.registry.stage_file(model_id, path)
+        self._warm_bundle(bundle, raw_scores, num_iterations)
+        self.metrics.add_warmup_credit(backend_compile_count() - c0,
+                                       self.metrics.cache_misses - m0)
+        return bundle

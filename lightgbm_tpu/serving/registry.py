@@ -34,7 +34,7 @@ class ModelBundle:
                  num_features: int, objective=None,
                  average_output: bool = False,
                  feature_names: Optional[List[str]] = None,
-                 pandas_categorical=None):
+                 pandas_categorical=None, host_models=None):
         self.model_id = model_id
         self.trees = trees
         self.num_class = num_class
@@ -46,7 +46,13 @@ class ModelBundle:
         self.pandas_categorical = pandas_categorical
         self.total_iterations = int(trees.leaf_value.shape[0])
         self.generation = 0       # bumped by ModelRegistry.register
+        # host-side trees (HostTree/LoadedTree), kept for the serving
+        # traversal's SoA pack (serving/traversal.py); None disables the
+        # traversal backend for this bundle (replay fallback)
+        self.host_models = host_models
         self._capped: Dict[int, "jnp.ndarray"] = {}
+        self._flat: Dict[bool, tuple] = {}        # quantize -> (forest, depth)
+        self._flat_capped: Dict[tuple, object] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -71,7 +77,8 @@ class ModelBundle:
                    num_features=nf, objective=impl.objective,
                    average_output=impl.average_output,
                    feature_names=feature_names,
-                   pandas_categorical=pandas_categorical)
+                   pandas_categorical=pandas_categorical,
+                   host_models=list(models[:total]))
 
     @classmethod
     def from_booster(cls, model_id: str, booster) -> "ModelBundle":
@@ -97,6 +104,35 @@ class ModelBundle:
                                                    self.trees)
             return self._capped[iters]
 
+    def flat_for(self, num_iteration: Optional[int] = None,
+                 quantize: bool = False):
+        """``(FlatForest, depth)`` for the serving traversal backend:
+        packed ONCE per bundle (== per model generation — a hot-roll swaps
+        the whole bundle, so stale tables die with it), device-put, and
+        sliced/cached per ``num_iteration`` cap like ``trees_for``. The
+        full-ensemble depth bounds every capped slice too."""
+        if self.host_models is None:
+            raise LightGBMError(
+                "model %r has no host-side trees; the traversal backend "
+                "needs a bundle built by from_impl/from_booster "
+                "(serving_backend=replay serves bare-tree bundles)"
+                % self.model_id)
+        iters = self.effective_iterations(num_iteration)
+        t = iters * self.num_tree_per_iteration
+        q = bool(quantize)
+        with self._lock:
+            if q not in self._flat:
+                from .traversal import pack_flat_forest
+                host, depth = pack_flat_forest(self.host_models, quantize=q)
+                self._flat[q] = (jax.tree.map(jnp.asarray, host), depth)
+            full, depth = self._flat[q]
+            if t == self.total_iterations * self.num_tree_per_iteration:
+                return full, depth
+            key = (t, q)
+            if key not in self._flat_capped:
+                self._flat_capped[key] = jax.tree.map(lambda a: a[:t], full)
+            return self._flat_capped[key], depth
+
 
 class ModelRegistry:
     """Named, immutable model bundles (the serving fleet's model store).
@@ -116,11 +152,22 @@ class ModelRegistry:
     def load_file(self, model_id: str, path: str,
                   replace: bool = False) -> ModelBundle:
         """Load a LightGBM model-text file (io/model_text.py format)."""
+        return self.register(self.stage_file(model_id, path), replace=replace)
+
+    def stage_file(self, model_id: str, path: str) -> ModelBundle:
+        """Build a bundle from a model file WITHOUT registering it, its
+        generation pre-set to the value ``register`` will assign. Lets a
+        hot-roller compile the next generation's predictors off the
+        request path (ServingEngine.prewarm_bundle) before the atomic
+        ``register(..., replace=True)`` swap."""
         from ..basic import Booster
         from ..io.model_text import parse_model_file
         parse_model_file(path)   # fail fast with a format error, not mid-serve
         booster = Booster(model_file=path)
-        return self.register_booster(model_id, booster, replace=replace)
+        bundle = ModelBundle.from_booster(model_id, booster)
+        with self._lock:
+            bundle.generation = self._generation.get(model_id, 0) + 1
+        return bundle
 
     def register_booster(self, model_id: str, booster,
                          replace: bool = False) -> ModelBundle:
@@ -177,14 +224,21 @@ class ModelRegistry:
     # ------------------------------------------------- checkpoint hot-roll
     def watch_dir(self, model_id: str, checkpoint_dir: str,
                   poll_interval: float = 10.0,
-                  start: bool = False) -> "CheckpointWatcher":
+                  start: bool = False, engine=None) -> "CheckpointWatcher":
         """Hot-roll the newest valid snapshot of a lightgbm_tpu.checkpoint
         directory into this registry under ``model_id``. Returns a watcher;
         call ``poll()`` for one synchronous check (the first poll registers
         the current snapshot) or pass ``start=True`` for a daemon-thread
         loop. Replacement is atomic and invalidates the model's compiled
-        predictors via the replace listeners."""
-        w = CheckpointWatcher(self, model_id, checkpoint_dir, poll_interval)
+        predictors via the replace listeners.
+
+        With ``engine`` (a ServingEngine), every poll that finds a newer
+        snapshot PREWARMS it first — the staged bundle's predictors are
+        compiled off the request path and credited to the warmup floor,
+        then the swap commits; live traffic never waits on a compile and
+        the zero-recompile-after-warmup invariant survives the roll."""
+        w = CheckpointWatcher(self, model_id, checkpoint_dir, poll_interval,
+                              engine=engine)
         if start:
             w.start()
         return w
@@ -194,11 +248,13 @@ class CheckpointWatcher:
     """Polls a checkpoint directory's manifest; loads newer snapshots."""
 
     def __init__(self, registry: ModelRegistry, model_id: str,
-                 checkpoint_dir: str, poll_interval: float = 10.0):
+                 checkpoint_dir: str, poll_interval: float = 10.0,
+                 engine=None):
         self.registry = registry
         self.model_id = model_id
         self.checkpoint_dir = checkpoint_dir
         self.poll_interval = float(poll_interval)
+        self.engine = engine
         self._last_id = -1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -207,7 +263,8 @@ class CheckpointWatcher:
         """One check: register the newest valid snapshot if it is newer
         than what we already rolled in. Returns True when a (re)load
         happened; verification failures fall back exactly like resume
-        does (manifest checksums, newest -> oldest)."""
+        does (manifest checksums, newest -> oldest). With an attached
+        engine the staged bundle is prewarmed BEFORE the swap."""
         from ..checkpoint.manager import CheckpointManager
         from ..log import Log
         latest = CheckpointManager(self.checkpoint_dir).latest_model()
@@ -216,7 +273,11 @@ class CheckpointWatcher:
         snap_id, model_path = latest
         if snap_id <= self._last_id:
             return False
-        self.registry.load_file(self.model_id, model_path, replace=True)
+        if self.engine is not None:
+            bundle = self.engine.stage_and_prewarm(self.model_id, model_path)
+        else:
+            bundle = self.registry.stage_file(self.model_id, model_path)
+        self.registry.register(bundle, replace=True)
         self._last_id = snap_id
         Log.info("serving: hot-rolled snapshot %d from %s into model %r",
                  snap_id, self.checkpoint_dir, self.model_id)

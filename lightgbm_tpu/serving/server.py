@@ -175,7 +175,11 @@ def build_app(config: Config) -> ServingApp:
     under id "default" — tests/embedders register models themselves."""
     engine = ServingEngine(
         max_batch=config.serve_max_batch, min_bucket=config.serve_min_bucket,
-        num_devices=config.serve_num_devices)
+        num_devices=config.serve_num_devices,
+        backend=config.serving_backend,
+        cascade_trees=config.serving_cascade_trees,
+        cascade_margin=config.serving_cascade_margin,
+        quantize_leaves=config.serving_quantize_leaves)
     if config.input_model:
         engine.registry.load_file("default", config.input_model)
     app = ServingApp(engine, MicroBatchQueue(

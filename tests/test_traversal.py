@@ -1,0 +1,303 @@
+"""Serving SoA traversal: parity vs the replay path, cascades, hot-roll.
+
+The traversal backend (serving/traversal.py) must be bit-identical to the
+training-side replay path (core/tree.py) for every decision the reference
+Tree::Predict makes — numerical splits, categorical bitsets, missing-value
+default directions, num_iteration truncation, multiclass — because the
+serving golden tests pin Booster.predict parity at 1e-6 and the two paths
+share one decision function (core/tree.py decision_go_left).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu import callback
+from lightgbm_tpu.serving import (ModelRegistry, ServingEngine,
+                                  forest_scores_flat, pack_flat_forest)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _flat_scores(impl, X, k=1, cascade_trees=0, cascade_margin=10.0,
+                 quantize=False, ntrees=None):
+    import jax
+    import jax.numpy as jnp
+    models = impl.models if ntrees is None else impl.models[:ntrees]
+    flat, depth = pack_flat_forest(models, quantize=quantize)
+    dev = jax.tree.map(jnp.asarray, flat)
+    return np.asarray(forest_scores_flat(
+        dev, jnp.asarray(np.asarray(X, np.float32)), k, depth,
+        cascade_trees=cascade_trees, cascade_margin=cascade_margin))
+
+
+def _replay_scores(impl, X, k=1, ntrees=None):
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.core import tree as tree_mod
+    t = len(impl.models) if ntrees is None else ntrees
+    stacked = impl._stacked_predict_trees(0, t)
+    trees = jax.tree.map(lambda a: a.reshape((t // k, k) + a.shape[1:]),
+                         stacked)
+    return np.asarray(tree_mod.predict_forest_scores(
+        trees, jnp.asarray(np.asarray(X, np.float32))))
+
+
+# ------------------------------------------------------------ dense parity
+def test_traversal_matches_replay_dense():
+    X, y = make_binary(n=600, f=10)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    Xq = np.random.RandomState(1).rand(257, 10).astype(np.float32)
+    out = _flat_scores(bst._impl, Xq)
+    ref = _replay_scores(bst._impl, Xq)
+    assert np.array_equal(out, ref)     # bit-exact, not just close
+
+
+def test_traversal_matches_replay_missing_values():
+    """NaN routing must follow the node's missing_type/default_left —
+    the decision function is shared, but the traversal gathers its
+    fields through a different layout."""
+    rng = np.random.RandomState(3)
+    X, y = make_binary(n=800, f=8)
+    X = np.asarray(X, np.float32).copy()
+    X[rng.rand(*X.shape) < 0.15] = np.nan
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "use_missing": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    Xq = np.asarray(X[:300], np.float32).copy()
+    Xq[rng.rand(*Xq.shape) < 0.3] = np.nan
+    assert np.array_equal(_flat_scores(bst._impl, Xq),
+                          _replay_scores(bst._impl, Xq))
+
+
+def test_traversal_matches_replay_categorical():
+    rng = np.random.RandomState(7)
+    n = 900
+    X = np.zeros((n, 4), np.float32)
+    X[:, 0] = rng.randint(0, 12, n)           # categorical
+    X[:, 1] = rng.rand(n)
+    X[:, 2] = rng.randint(0, 40, n)           # categorical, wider
+    X[:, 3] = rng.randn(n)
+    y = ((X[:, 0] % 3 == 0) ^ (X[:, 1] > 0.5)).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0, 2]),
+                    num_boost_round=6, categorical_feature=[0, 2])
+    # in-range, out-of-range and negative categories all route the same
+    Xq = X[:200].copy()
+    Xq[:5, 0] = [-1.0, 99.0, 11.0, 0.0, 3.0]
+    assert np.array_equal(_flat_scores(bst._impl, Xq),
+                          _replay_scores(bst._impl, Xq))
+
+
+def test_traversal_matches_replay_efb():
+    """EFB-bundled training still extracts per-feature host trees; the
+    traversal serves them identically."""
+    rng = np.random.RandomState(11)
+    X = np.zeros((500, 12), np.float32)
+    for j in range(12):                       # sparse, bundleable columns
+        mask = rng.rand(500) < 0.15
+        X[mask, j] = rng.rand(int(mask.sum()))
+    y = (X.sum(axis=1) > 0.2).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "enable_bundle": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    assert np.array_equal(_flat_scores(bst._impl, X[:200]),
+                          _replay_scores(bst._impl, X[:200]))
+
+
+def test_traversal_num_iteration_truncation():
+    X, y = make_binary(n=400, f=6)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=9)
+    Xq = np.asarray(X[:128], np.float32)
+    for ntrees in (1, 4, 9):
+        assert np.array_equal(
+            _flat_scores(bst._impl, Xq, ntrees=ntrees),
+            _replay_scores(bst._impl, Xq, ntrees=ntrees)), ntrees
+
+
+def test_traversal_multiclass():
+    rng = np.random.RandomState(5)
+    X = rng.rand(600, 8).astype(np.float32)
+    y = (X[:, 0] * 3).astype(np.int32).clip(0, 2)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    Xq = X[:200]
+    out = _flat_scores(bst._impl, Xq, k=3)
+    ref = _replay_scores(bst._impl, Xq, k=3)
+    assert out.shape == (200, 3)
+    assert np.array_equal(out, ref)
+
+
+# ------------------------------------------------------------ engine parity
+@pytest.mark.parametrize("raw", [False, True])
+def test_engine_traversal_vs_replay_backends(raw):
+    """The two ServingEngine backends serve byte-identical outputs (and
+    both match Booster.predict, which the serving goldens already pin)."""
+    X, y = make_binary(n=500, f=9)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=6)
+    Xq = np.random.RandomState(2).rand(77, 9).astype(np.float32)
+    outs = {}
+    for backend in ("traversal", "replay"):
+        eng = ServingEngine(max_batch=128, min_bucket=16, backend=backend)
+        eng.registry.register_booster("m", bst)
+        outs[backend] = eng.predict("m", Xq, raw_score=raw)
+        assert eng._cache and all(
+            e.backend == backend for e in eng._cache.values())
+    assert np.array_equal(outs["traversal"], outs["replay"])
+    assert np.allclose(outs["traversal"], bst.predict(Xq, raw_score=raw),
+                       atol=1e-6)
+
+
+# ------------------------------------------------------------ cascade
+def test_cascade_margin_inf_is_bit_identical():
+    X, y = make_binary(n=500, f=8)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    Xq = np.random.RandomState(4).rand(300, 8).astype(np.float32)
+    full = _flat_scores(bst._impl, Xq)
+    casc = _flat_scores(bst._impl, Xq, cascade_trees=3,
+                        cascade_margin=float("inf"))
+    assert np.array_equal(full, casc)
+
+
+def test_cascade_margin_zero_serves_stage_one_only():
+    X, y = make_binary(n=500, f=8)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    Xq = np.random.RandomState(4).rand(300, 8).astype(np.float32)
+    stage1 = _flat_scores(bst._impl, Xq, ntrees=3)
+    casc = _flat_scores(bst._impl, Xq, cascade_trees=3, cascade_margin=0.0)
+    assert np.array_equal(stage1, casc)
+
+
+def test_cascade_engine_end_to_end():
+    """A cascade engine with a generous margin must still match the full
+    model on confident rows and stay within the margin bound elsewhere;
+    with margin=inf it matches everywhere (transforms included)."""
+    X, y = make_binary(n=600, f=8)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    Xq = np.random.RandomState(6).rand(200, 8).astype(np.float32)
+    eng = ServingEngine(max_batch=256, min_bucket=16,
+                        cascade_trees=4, cascade_margin=float("inf"))
+    eng.registry.register_booster("m", bst)
+    assert np.allclose(eng.predict("m", Xq), bst.predict(Xq), atol=1e-6)
+
+
+# ------------------------------------------------------------ quantized leaves
+def test_quantized_leaves_close_not_exact():
+    X, y = make_binary(n=500, f=8)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    Xq = np.random.RandomState(8).rand(300, 8).astype(np.float32)
+    ref = _replay_scores(bst._impl, Xq)
+    outq = _flat_scores(bst._impl, Xq, quantize=True)
+    scale = max(float(np.abs(ref).max()), 1e-9)
+    assert np.abs(outq - ref).max() / scale < 1e-3
+    eng = ServingEngine(max_batch=256, min_bucket=16, quantize_leaves=True)
+    eng.registry.register_booster("m", bst)
+    assert np.allclose(eng.predict("m", Xq, raw_score=True), ref[:, 0],
+                       atol=1e-3)
+
+
+# ------------------------------------------------------------ hot-roll prewarm
+def test_prewarm_hot_roll_zero_recompiles(tmp_path):
+    """Staged-generation hot-roll: prewarm compiles the next generation
+    off the request path, the generation-aware purge keeps those entries
+    at commit, and the recompile/miss floors absorb the prewarm — the
+    zero-recompile-after-warmup invariant survives the roll."""
+    X, y = make_binary(n=400, f=6)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    bst_a = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    bst_b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    path_a = str(tmp_path / "a.txt")
+    path_b = str(tmp_path / "b.txt")
+    bst_a.save_model(path_a)
+    bst_b.save_model(path_b)
+
+    Xq = np.random.RandomState(9).rand(40, 6).astype(np.float32)
+    # reference BEFORE warmup: Booster.predict's own compiles must not
+    # pollute the post-warmup recompile count (serve_smoke.py idiom)
+    ref_b = bst_b.predict(Xq)
+
+    eng = ServingEngine(max_batch=64, min_bucket=16)
+    eng.registry.load_file("m", path_a)
+    warmed = eng.warmup()
+    assert warmed == eng.cache_size()
+    eng.predict("m", Xq)
+
+    staged = eng.stage_and_prewarm("m", path_b)
+    assert staged.generation == eng.registry.generation("m") + 1
+    eng.registry.register(staged, replace=True)
+    # stale generation purged, prewarmed generation kept
+    assert eng.cache_size() == warmed
+    out = eng.predict("m", Xq)
+    assert np.allclose(out, ref_b, atol=1e-6)
+    assert eng.metrics.cache_misses_after_warmup() == 0
+    assert eng.metrics.recompiles_after_warmup() == 0
+    snap = eng.metrics.snapshot()
+    assert snap["warmup_credit_compiles"] >= 1
+    assert snap["warmup_credit_misses"] == warmed
+
+
+def test_generation_aware_purge_without_prewarm(tmp_path):
+    """A plain (non-prewarmed) replace still drops every stale entry —
+    the pre-existing hot-roll contract (test_checkpoint relies on it)."""
+    X, y = make_binary(n=300, f=5)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    p = str(tmp_path / "m.txt")
+    bst.save_model(p)
+    eng = ServingEngine(max_batch=32, min_bucket=16)
+    eng.registry.load_file("m", p)
+    eng.warmup()
+    assert eng.cache_size() > 0
+    eng.registry.load_file("m", p, replace=True)
+    assert eng.cache_size() == 0
+
+
+def test_watcher_prewarms_through_engine(tmp_path):
+    """watch_dir(engine=...) rolls a newer checkpoint in with zero
+    post-warmup recompiles visible to the serving invariant."""
+    from lightgbm_tpu.checkpoint.manager import CheckpointManager
+
+    X, y = make_binary(n=400, f=6)
+    d = str(tmp_path / "ckpt")
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    cbs = [callback.checkpoint(d, period=1)]
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2,
+              callbacks=cbs)
+    eng = ServingEngine(max_batch=64, min_bucket=16)
+    w = eng.registry.watch_dir("m", d, engine=eng)
+    assert w.poll()
+    eng.warmup()
+    Xq = np.random.RandomState(10).rand(30, 6).astype(np.float32)
+    eng.predict("m", Xq)
+    gen0 = eng.registry.generation("m")
+
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4,
+              callbacks=cbs, resume_from=d)
+    assert CheckpointManager(d).latest_model() is not None
+    # the in-process resume training above compiles its own programs;
+    # only compiles from the poll/hot-roll/serve below are under test
+    rec_floor = eng.metrics.recompiles_after_warmup()
+    assert w.poll()
+    assert eng.registry.generation("m") == gen0 + 1
+    eng.predict("m", Xq)
+    assert eng.metrics.cache_misses_after_warmup() == 0
+    assert eng.metrics.recompiles_after_warmup() == rec_floor
