@@ -1325,6 +1325,11 @@ class GBDT:
         while done < num_iters and not self._stopped:
             block = min(num_iters - done, 64)
             self._last_block_len = block
+            obs = self.obs
+            # host window opens before feature sampling: mask/bag-key prep
+            # is host-side work attributed to busy_s in the distributed
+            # per-block comm/compute split
+            t0 = time.perf_counter() if obs.enabled else 0.0
             fn = self._compiled_block
             fmasks = jnp.stack([self._sample_feature_mask()
                                 for _ in range(block)])
@@ -1338,9 +1343,8 @@ class GBDT:
                                          dtype=np.int32))
             all_keys = jax.random.split(self._bag_key, block + 1)
             self._bag_key = all_keys[0]
-            obs = self.obs
             obs.perfetto_step(self.iter_, self.iter_ + block)
-            t0 = time.perf_counter() if obs.enabled else 0.0
+            t_disp = t0
             with obs.span("train_block", start_iter=self.iter_,
                           count=block):
                 packs, healths, self.scores, self._bag_mask, \
@@ -1350,10 +1354,14 @@ class GBDT:
                         self._bag_mask, self._cegb_state, self._stopped_dev,
                         jnp.float32(self.shrinkage_rate))
                 if obs.enabled:
+                    # async dispatch returned: host work ends here, the
+                    # remainder of the block wall is device wait
+                    t_disp = time.perf_counter()
                     # one sync at span close; basic mode's only added
                     # barrier, and the block boundary already is one for
                     # the flush cadence
                     jax.block_until_ready(self.scores)  # lgbm-lint: disable=LGL103 span close
+            t_done = time.perf_counter() if obs.enabled else 0.0
             self._pending.append({"packed": packs,
                                   "shrinkage": self.shrinkage_rate,
                                   "count": block})
@@ -1362,8 +1370,10 @@ class GBDT:
             if obs.enabled:
                 hrows = np.asarray(healths)
                 obs.dispatch_done(self.iter_ - block, block,
-                                  time.perf_counter() - t0,
-                                  health_rows=hrows)
+                                  t_done - t0,
+                                  health_rows=hrows,
+                                  busy_s=t_disp - t0,
+                                  wait_s=t_done - t_disp)
                 obs.record_hbm()
                 obs.check_health(hrows, self.iter_ - block, booster=self)
             elif obs.health_enabled:
@@ -1544,6 +1554,9 @@ class GBDT:
             self._compiled_iter = self._make_train_iter_fn()
 
         iter_idx = self.iter_
+        obs = self.obs
+        # host window opens before mask sampling (matches train_many)
+        t0 = time.perf_counter() if obs.enabled else 0.0
         sample_mask = self._sample_bagging_mask(iter_idx)
         feature_mask = self._sample_feature_mask()
 
@@ -1562,9 +1575,8 @@ class GBDT:
             h_in = jnp.ones((n, k), jnp.float32)
 
         self._bag_key, goss_key = jax.random.split(self._bag_key)
-        obs = self.obs
         obs.perfetto_step(iter_idx, iter_idx + 1)
-        t0 = time.perf_counter() if obs.enabled else 0.0
+        t_disp = t0
         with obs.span("train_iter", iteration=iter_idx):
             packed, leaf_ids, new_scores, cegb_new, self._stopped_dev, \
                 health = self._compiled_iter(
@@ -1574,10 +1586,12 @@ class GBDT:
                     jnp.float32(self._goss_active(iter_idx)), goss_key,
                     self._cegb_state, self._stopped_dev)
             if obs.enabled:
+                t_disp = time.perf_counter()
                 # span-close sync: the per-iteration path is already the
                 # slow (full/host-logic) path, so one barrier per
                 # iteration is the accepted cost of true spans
                 jax.block_until_ready(new_scores)  # lgbm-lint: disable=LGL103 span close
+        t_done = time.perf_counter() if obs.enabled else 0.0
         self.scores = new_scores
         self._cegb_state = cegb_new
 
@@ -1588,8 +1602,9 @@ class GBDT:
         self.iter_ += 1
         if obs.enabled:
             hrow = np.asarray(health)[None]
-            obs.dispatch_done(iter_idx, 1, time.perf_counter() - t0,
-                              health_rows=hrow)
+            obs.dispatch_done(iter_idx, 1, t_done - t0,
+                              health_rows=hrow,
+                              busy_s=t_disp - t0, wait_s=t_done - t_disp)
             if obs.per_iteration:
                 obs.record_hbm()
             obs.check_health(hrow, iter_idx, booster=self)

@@ -126,6 +126,13 @@ class _Checkpoint:
             Log.warning("checkpoint: SIGTERM received; snapshot saved at "
                         "iteration %d in %s; exiting", it,
                         self.manager.directory)
+            obs = getattr(env.model._impl, "obs", None)
+            if obs is not None and hasattr(obs, "crash_flush"):
+                # fsync the event stream + dump the flight recorder NOW,
+                # while training state is still coherent; _resign()
+                # re-delivers SIGTERM to the previous handler (the
+                # recorder's, which finds its dump already latched)
+                obs.crash_flush("sigterm")
             self._resign()
 
 

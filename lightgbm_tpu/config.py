@@ -249,6 +249,18 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # else off; abort = checkpoint (checkpoint_dir) then raise
     ("health_monitor", str, "auto",
      ["health_monitor_action", "obs_health"]),
+    # ---- distributed obs (obs/distributed.py) ----
+    # cross-process metric federation + straggler detection: auto = armed
+    # whenever observability is on AND jax.process_count() > 1; on forces
+    # it even single-process (degenerate local view); off disables
+    ("obs_distributed", str, "auto", []),
+    # warn when max/median per-process block wall time crosses this
+    # ratio (routed through HealthMonitor, warn-only); 0 disables
+    ("obs_straggler_warn_skew", float, 2.0, ["straggler_warn_skew"]),
+    # flight-recorder ring size: recent events kept in memory per process
+    # and dumped to <obs_event_file>.<process>.crash.jsonl on HealthMonitor
+    # abort, SIGTERM, or unhandled exception; 0 = off
+    ("obs_flight_recorder", int, 512, ["obs_flight_recorder_size"]),
 ]
 
 # known spellings, validated in _post_process (a typo'd kernel or growth
@@ -258,6 +270,7 @@ TREE_GROW_MODES = ("exact", "batched", "frontier")
 SERVING_BACKENDS = ("traversal", "replay")
 OBSERVABILITY_LEVELS = ("none", "basic", "full")
 HEALTH_MONITOR_ACTIONS = ("auto", "none", "warn", "abort", "raise")
+OBS_DISTRIBUTED_MODES = ("auto", "on", "off")
 HIST_IMPLS = ("auto", "matmul", "scatter", "pallas", "pallas_highest",
               "pallas_interpret", "pallas_highest_interpret")
 
@@ -485,6 +498,20 @@ class Config:
         if self.obs_perfetto_start < 0 or self.obs_perfetto_iters < 0:
             raise LightGBMError("obs_perfetto_start/obs_perfetto_iters "
                                 "should be >= 0")
+        self.obs_distributed = str(self.obs_distributed).strip().lower()
+        if self.obs_distributed not in OBS_DISTRIBUTED_MODES:
+            raise LightGBMError("obs_distributed should be one of %s, "
+                                "got %s"
+                                % ("/".join(OBS_DISTRIBUTED_MODES),
+                                   self.obs_distributed))
+        if self.obs_straggler_warn_skew < 0:
+            raise LightGBMError("obs_straggler_warn_skew should be >= 0 "
+                                "(0 disables), got %s"
+                                % self.obs_straggler_warn_skew)
+        if self.obs_flight_recorder < 0:
+            raise LightGBMError("obs_flight_recorder should be >= 0 "
+                                "(0 = off), got %s"
+                                % self.obs_flight_recorder)
         self.serving_backend = str(self.serving_backend).strip().lower()
         if self.serving_backend not in SERVING_BACKENDS:
             raise LightGBMError("serving_backend should be one of %s, got %s"

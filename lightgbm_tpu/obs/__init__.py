@@ -20,7 +20,12 @@ serving:
   grad/hess, zero-positive-gain waves) that the training step piggy-backs
   on existing reductions — warn, checkpoint-and-abort, or raise.
 - ``server``: an optional lightweight stats HTTP endpoint during training
-  (Prometheus text + JSON snapshot + healthz).
+  (Prometheus text + JSON snapshot + healthz + federated cluster routes).
+- ``distributed``: multi-process telemetry — metric federation (global
+  ``process=``/``host=`` labels, once-per-block snapshot allgather served
+  from ``/metrics/cluster`` + ``/stats/cluster``), per-block comm/compute
+  attribution with straggler-skew detection, and a crash-dumping flight
+  recorder (``<obs_event_file>.<process>.crash.jsonl``).
 - ``runtime``: ``TrainingObs``, the per-booster facade built from the
   ``observability=none|basic|full`` config knob that the boosting loop
   drives.
@@ -40,3 +45,6 @@ from .runtime import TrainingObs, resolve_health_action  # noqa: F401
 from .server import StatsServer  # noqa: F401
 from .trace import (EventStream, Tracer, perfetto_trace,  # noqa: F401
                     span)
+from .distributed import (DistributedObs, FlightRecorder,  # noqa: F401
+                          merge_prometheus_texts, process_env,
+                          straggler_skew)

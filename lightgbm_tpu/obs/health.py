@@ -78,7 +78,7 @@ class HealthMonitor:
 
     def __init__(self, action: str = "warn",
                  registry: Optional[MetricsRegistry] = None,
-                 events=None, on_abort=None):
+                 events=None, on_abort=None, on_fatal=None):
         if action not in _ACTIONS:
             raise LightGBMError("unknown health_monitor action %r "
                                 "(expected one of %s)"
@@ -87,6 +87,10 @@ class HealthMonitor:
         self.reports: List[HealthReport] = []
         self._events = events
         self._on_abort = on_abort
+        # invoked right before the monitor raises (abort AND raise):
+        # TrainingObs hooks the flight-recorder dump + event fsync here
+        # so the crash artifacts exist before the exception unwinds
+        self._on_fatal = on_fatal
         reg = registry if registry is not None else get_registry()
         self._c_anomaly = reg.counter(
             "lgbm_train_health_anomalies_total",
@@ -97,9 +101,33 @@ class HealthMonitor:
         self._g_waves = reg.gauge(
             "lgbm_train_frontier_waves_last",
             "Frontier waves executed by the most recent iteration.")
+        self._c_straggler = reg.counter(
+            "lgbm_train_straggler_reports_total",
+            "Straggler-skew reports routed through the health monitor "
+            "(warn-only; stragglers never escalate).")
 
     def anomaly_count(self) -> int:
         return int(self._c_anomaly.value)
+
+    def note_straggler(self, iteration: int, process: int, skew: float,
+                       threshold: float) -> HealthReport:
+        """Record a straggler-skew crossing from distributed obs.  Like
+        stump iterations, stragglers warn and count but NEVER escalate —
+        a slow peer is an infrastructure symptom, not a reason to abort
+        an otherwise-healthy optimization."""
+        r = HealthReport(
+            int(iteration), "straggler_wave",
+            "process %d is a straggler at iteration %d: block wall-time "
+            "skew %.2fx >= warn threshold %.2fx"
+            % (int(process), int(iteration), float(skew), float(threshold)))
+        self.reports.append(r)
+        self._c_straggler.inc()
+        if self._events is not None:
+            self._events.write("health", iteration=r.iteration, kind=r.kind,
+                               message=r.message, process=int(process),
+                               skew=round(float(skew), 4))
+        Log.warning("health: %s" % r.message)
+        return r
 
     def check(self, health_rows, start_iter: int, booster=None
               ) -> List[HealthReport]:
@@ -141,6 +169,11 @@ class HealthMonitor:
                     self._on_abort(booster, first)
                 except Exception as e:
                     Log.warning("health abort checkpoint failed: %s" % e)
+            if self._on_fatal is not None:
+                try:
+                    self._on_fatal(first)
+                except Exception as e:
+                    Log.warning("health fatal hook failed: %s" % e)
             raise LightGBMError(
                 "training aborted by health monitor: %s" % first.message)
         return new

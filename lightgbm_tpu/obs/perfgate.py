@@ -66,18 +66,21 @@ def default_spec(name: str) -> Dict[str, Any]:
 
 
 # ------------------------------------------------------------ measurement
-def _psum_per_wave() -> Optional[float]:
+def _psum_per_wave(param_overrides: Optional[Dict[str, Any]] = None
+                   ) -> Optional[float]:
     """Per-wave collective count of the sharded frontier grower under
     the 8-device mesh — the shared analysis/jaxpr_audit.py entry and
     equation walk (one construction; the audit baseline and
     tests/test_obs.py pin the same program). None when fewer than 8
     devices exist — the gate CLI re-execs itself with a virtual-device
-    flag to guarantee them."""
+    flag to guarantee them.  ``param_overrides`` forwards to the audit
+    entry: the gate measures the observability-on branch too, pinning
+    that distributed telemetry never adds a collective."""
     import jax
 
     from ..analysis import jaxpr_audit
 
-    entry = jaxpr_audit.sharded_frontier_fn()
+    entry = jaxpr_audit.sharded_frontier_fn(param_overrides=param_overrides)
     if entry is None:
         return None
     fn, args, params = entry
@@ -162,6 +165,14 @@ def measure(workload: Optional[Dict[str, Any]] = None
     psum = _psum_per_wave()
     if psum is not None:
         counters["psum_per_wave_branch"] = psum
+    # same program with the device health branch (the only compiled-code
+    # obs surface) enabled: distributed telemetry is host-metadata-only,
+    # so the per-wave collective count must be IDENTICAL to the plain
+    # branch — a new psum here means someone put a collective on the
+    # telemetry path
+    psum_obs = _psum_per_wave(param_overrides={"obs_health": True})
+    if psum_obs is not None:
+        counters["psum_per_wave_branch_obs"] = psum_obs
     return counters, wl
 
 

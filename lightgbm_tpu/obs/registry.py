@@ -239,22 +239,39 @@ class Histogram(_Metric):
         observations in the +Inf bucket clamp to the last finite bound).
         Coarse by construction, but aggregatable — unlike a windowed
         quantile — which is why serving's per-bucket latency view rides
-        it (docs/Serving.md)."""
-        q = min(max(float(q), 0.0), 1.0)
+        it (docs/Serving.md).
+
+        Hardened edge cases (the serving p99 SLO gate in
+        tools/load_test.py consumes this and must never see NaN/None):
+        an empty histogram returns 0.0; a non-finite ``q`` raises instead
+        of propagating NaN through the comparisons; observations that
+        only ever landed in the first bucket interpolate within
+        ``[0, bounds[0]]``; everything in the +Inf overflow bucket clamps
+        to the last finite bound; a non-finite bucket bound clamps to the
+        bucket's lower edge."""
+        qf = float(q)
+        if qf != qf or qf in (float("inf"), float("-inf")):
+            raise ValueError("histogram quantile q must be finite, got %r"
+                             % q)
+        qf = min(max(qf, 0.0), 1.0)
         with self._lock:
             counts = list(self._counts)
         total = sum(counts)
         if total == 0:
             return 0.0
-        rank = q * total
+        rank = qf * total
         cum = 0.0
         lo = 0.0
         for bound, c in zip(self._bounds, counts):
             if c > 0 and cum + c >= rank:
                 frac = min(max((rank - cum) / c, 0.0), 1.0)
+                if bound - lo != bound - lo or bound == float("inf"):
+                    return lo          # non-finite bound: clamp, not NaN
                 return lo + (bound - lo) * frac
             cum += c
             lo = bound
+        # every observation sits in the +Inf overflow bucket: the last
+        # finite bound is the best (and only finite) answer
         return self._bounds[-1]
 
     def samples(self):
@@ -285,6 +302,23 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: Dict[Tuple, _Metric] = {}
         self._help: Dict[str, str] = {}
+        # constant labels appended to EVERY exported sample (exposition
+        # time only — call sites and stored metric keys never see them).
+        # Distributed training sets process=<index>/host=<name> here so
+        # per-process scrapes federate without relabeling (ISSUE 10);
+        # empty by default, which keeps the golden exposition byte-stable.
+        self._global_labels: Tuple[Tuple[str, str], ...] = ()
+
+    def set_global_labels(self, labels: Optional[Dict[str, str]]) -> None:
+        """Install constant labels injected into every exported sample
+        (``prometheus_text`` and ``snapshot``).  Pass None/{} to clear."""
+        with self._lock:
+            self._global_labels = tuple(sorted(
+                (str(k), str(v)) for k, v in (labels or {}).items()))
+
+    def global_labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._global_labels)
 
     def _get(self, kind: str, name: str, help: str,
              labels: Optional[Dict[str, str]], **kw) -> _Metric:
@@ -329,6 +363,8 @@ class MetricsRegistry:
         """Prometheus text exposition format 0.0.4.  Families sorted by
         name, series by label string — the output is deterministic for a
         given registry state (the golden test pins it)."""
+        with self._lock:
+            extra = self._global_labels
         families: Dict[str, List[_Metric]] = {}
         for m in self.metrics():
             families.setdefault(m.name, []).append(m)
@@ -344,17 +380,20 @@ class MetricsRegistry:
             for m in sorted(group, key=lambda m: m.labels):
                 rows.extend(m.samples())
             for sample_name, labels, value in rows:
-                lines.append("%s%s %s" % (sample_name, _label_suffix(labels),
-                                          _fmt_value(value)))
+                lines.append("%s%s %s"
+                             % (sample_name, _label_suffix(labels, extra),
+                                _fmt_value(value)))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> Dict:
         """Flat JSON view: ``name{k="v"}`` -> value (summaries expand to
         quantile/sum/count keys)."""
+        with self._lock:
+            extra = self._global_labels
         out: Dict[str, float] = {}
         for m in self.metrics():
             for sample_name, labels, value in m.samples():
-                out[sample_name + _label_suffix(labels)] = value
+                out[sample_name + _label_suffix(labels, extra)] = value
         return {"ts": round(time.time(), 3), "metrics": out}
 
     def write_jsonl(self, path_or_fh) -> Dict:

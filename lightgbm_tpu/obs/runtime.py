@@ -50,7 +50,8 @@ class TrainingObs:
                  events: Optional[EventStream] = None,
                  perfetto: Optional[PerfettoWindow] = None,
                  stats: Optional[StatsServer] = None,
-                 checkpoint_dir: str = "", checkpoint_keep: int = 3):
+                 checkpoint_dir: str = "", checkpoint_keep: int = 3,
+                 flight=None):
         self.level = level
         self.registry = get_registry()
         self.events = events
@@ -58,6 +59,10 @@ class TrainingObs:
                              events=events, metric="lgbm_train_span_seconds")
         self.perfetto = perfetto
         self.stats = stats
+        self.dist = None          # DistributedObs, wired by from_config
+        self.flight = flight      # FlightRecorder (obs/distributed.py)
+        if flight is not None:
+            flight.install()
         self._checkpoint_dir = checkpoint_dir
         self._checkpoint_keep = checkpoint_keep
         self.monitor: Optional[HealthMonitor] = None
@@ -85,9 +90,29 @@ class TrainingObs:
     @classmethod
     def from_config(cls, config) -> "TrainingObs":
         level = LEVELS.get(getattr(config, "observability", "none"), 0)
+        # distributed identity first: the event stream stamps process/host
+        # onto every record and the flight recorder names its dump by
+        # process index, so both need it before construction
+        dist_mode = getattr(config, "obs_distributed", "auto")
+        pidx, pcount, phost = 0, 1, ""
+        dist_on = False
+        if level > 0 and dist_mode != "off":
+            from .distributed import process_env
+            pidx, pcount, phost = process_env()
+            dist_on = pcount > 1 or dist_mode == "on"
         events = None
+        flight = None
         if level > 0 and getattr(config, "obs_event_file", ""):
-            events = EventStream(config.obs_event_file)
+            if getattr(config, "obs_flight_recorder", 0) > 0:
+                from .distributed import FlightRecorder
+                flight = FlightRecorder(
+                    config.obs_event_file, process_index=pidx,
+                    size=config.obs_flight_recorder)
+            static = {"process": pidx, "host": phost} if dist_on else None
+            events = EventStream(config.obs_event_file,
+                                 static_fields=static, ring=flight)
+            if flight is not None:
+                flight._on_dump = lambda reason: events.flush(fsync=True)
         perfetto = None
         if (level >= 2 and getattr(config, "obs_perfetto_dir", "")
                 and getattr(config, "obs_perfetto_iters", 0) > 0):
@@ -102,16 +127,32 @@ class TrainingObs:
             except OSError as e:
                 Log.warning("obs: could not bind stats port %d: %s"
                             % (port, e))
-        return cls(level=level,
-                   health_action=resolve_health_action(config),
-                   events=events, perfetto=perfetto, stats=stats,
-                   checkpoint_dir=getattr(config, "checkpoint_dir", ""),
-                   checkpoint_keep=getattr(config, "checkpoint_keep", 3))
+        obs = cls(level=level,
+                  health_action=resolve_health_action(config),
+                  events=events, perfetto=perfetto, stats=stats,
+                  checkpoint_dir=getattr(config, "checkpoint_dir", ""),
+                  checkpoint_keep=getattr(config, "checkpoint_keep", 3),
+                  flight=flight)
+        if dist_on:
+            from .distributed import DistributedObs
+            obs.dist = DistributedObs(
+                registry=obs.registry, monitor=obs.monitor,
+                process_index=pidx, process_count=pcount, hostname=phost,
+                warn_skew=getattr(config, "obs_straggler_warn_skew", 2.0))
+            if stats is not None:
+                stats.set_cluster(obs.dist)
+        return obs
 
     def _make_monitor(self, action: str) -> None:
         self.monitor = HealthMonitor(action=action, registry=self.registry,
                                      events=self.events,
-                                     on_abort=self._abort_checkpoint)
+                                     on_abort=self._abort_checkpoint,
+                                     on_fatal=self._fatal_dump)
+        if self.dist is not None:
+            self.dist.monitor = self.monitor
+
+    def _fatal_dump(self, report) -> None:
+        self.crash_flush("health:%s" % getattr(report, "kind", "anomaly"))
 
     def _abort_checkpoint(self, booster, report) -> None:
         if booster is None or not self._checkpoint_dir:
@@ -163,21 +204,39 @@ class TrainingObs:
             self.perfetto.step(lo, hi)
 
     def dispatch_done(self, start_iter: int, count: int, dur_s: float,
-                      health_rows=None, **fields) -> None:
-        """Account one synced dispatch covering ``count`` iterations."""
+                      health_rows=None, busy_s=None, wait_s=None,
+                      **fields) -> None:
+        """Account one synced dispatch covering ``count`` iterations.
+
+        ``busy_s``/``wait_s``: the host/device wall-time split the
+        training loop measured around this dispatch (host: feature
+        sampling + dispatch until the async call returned; device: the
+        ``block_until_ready`` wait).  Feeds the distributed per-block
+        attribution + straggler allgather when more than one process
+        participates."""
         self._c_iters.inc(count)
         per_iter = dur_s / max(count, 1)
         for _ in range(count):
             self._s_iter.observe(per_iter)
+        waves = 0.0
         if health_rows is not None:
             waves = float(sum(r[3] for r in health_rows))
             if waves > 0:
                 self._g_wave_s.set(dur_s / waves)
         if self.events is not None:
             kind = "iteration" if count == 1 else "block"
+            if busy_s is not None:
+                fields = dict(fields, host_s=round(float(busy_s), 6))
+            if wait_s is not None:
+                fields = dict(fields, device_s=round(float(wait_s), 6))
             self.events.write(kind, iteration=start_iter, count=count,
                               dur_s=round(dur_s, 6),
                               iter_s=round(per_iter, 6), **fields)
+        if self.dist is not None:
+            b = float(busy_s) if busy_s is not None else 0.0
+            w = float(wait_s) if wait_s is not None \
+                else max(float(dur_s) - b, 0.0)
+            self.dist.on_block(start_iter, count, b, w, waves)
 
     def check_health(self, health_rows, start_iter: int,
                      booster=None) -> None:
@@ -198,6 +257,21 @@ class TrainingObs:
         except Exception:
             pass
 
+    def crash_flush(self, reason: str):
+        """The crash path: fsync the event stream, dump the flight
+        recorder.  Called from the HealthMonitor fatal hook, the
+        checkpoint callback's SIGTERM latch, and (via the recorder's own
+        hooks) SIGTERM/unhandled-exception.  Safe to call repeatedly —
+        the dump latches on first use."""
+        if self.events is not None:
+            try:
+                self.events.flush(fsync=True)
+            except Exception:
+                pass
+        if self.flight is not None:
+            return self.flight.dump(reason)
+        return None
+
     def finish(self) -> None:
         """End-of-training flush; the stats server stays up so callers
         (CI smoke, notebooks) can scrape final state before exit."""
@@ -209,3 +283,8 @@ class TrainingObs:
                 iterations=int(self._c_iters.value),
                 anomalies=(self.monitor.anomaly_count()
                            if self.monitor is not None else 0))
+        if self.flight is not None:
+            # a completed run keeps its ring but disarms the global
+            # SIGTERM/excepthook seams — post-training crashes belong to
+            # the embedding application, not this booster
+            self.flight.uninstall()

@@ -8,6 +8,12 @@ metrics registry while a training run is live:
 - ``GET /healthz``  -> ``{"status": "ok"|"anomalous", "anomalies": N}``
 - ``GET /roofline`` -> per-phase roofline attribution (obs/costmodel.py):
   extracted FLOPs/bytes per entry point joined with span wall times
+- ``GET /metrics/cluster`` / ``GET /stats/cluster`` -> the federated
+  cluster view (obs/distributed.py): every process's metrics merged,
+  served from the cache the once-per-block allgather refreshes — a
+  scrape never triggers a collective.  Single-process (or before
+  ``StatsServer.set_cluster`` wires a provider) these are exactly the
+  local ``/metrics`` / ``/stats`` bodies.
 
 Enabled via ``obs_stats_port`` (>= 0; 0 binds an OS-assigned port whose
 number is exported in ``StatsServer.port`` and logged).  A busy port is
@@ -35,6 +41,7 @@ class _Handler(BaseHTTPRequestHandler):
     # class attributes bound by StatsServer.start()
     registry: MetricsRegistry = None
     anomaly_counter = None
+    cluster = None   # DistributedObs (or None): set via set_cluster()
 
     def log_message(self, fmt, *args):  # quiet: route through our logger
         Log.debug("obs.server: " + fmt % args)
@@ -52,6 +59,18 @@ class _Handler(BaseHTTPRequestHandler):
                 body = self.registry.prometheus_text().encode()
                 self._send(200, body,
                            "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/metrics/cluster":
+                text = (self.cluster.cluster_prometheus()
+                        if self.cluster is not None
+                        else self.registry.prometheus_text())
+                self._send(200, text.encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/stats/cluster":
+                snap = (self.cluster.cluster_stats()
+                        if self.cluster is not None
+                        else self.registry.snapshot())
+                self._send(200, json.dumps(snap, sort_keys=True).encode(),
+                           "application/json")
             elif self.path == "/stats":
                 body = json.dumps(self.registry.snapshot(),
                                   sort_keys=True).encode()
@@ -107,9 +126,17 @@ class StatsServer:
                         "back to an ephemeral port" % (int(port), e))
             self._httpd = ThreadingHTTPServer((host, 0), handler)
         self._httpd.daemon_threads = True
+        self._handler = handler
         self.host = host
         self.port = int(self._httpd.server_address[1])
         self._thread: Optional[threading.Thread] = None
+
+    def set_cluster(self, provider) -> None:
+        """Wire the ``/metrics/cluster`` + ``/stats/cluster`` routes to a
+        DistributedObs (anything with ``cluster_prometheus()`` /
+        ``cluster_stats()``).  Without a provider the routes serve the
+        local registry — the single-process degenerate case."""
+        self._handler.cluster = provider
 
     def start(self) -> "StatsServer":
         self._thread = threading.Thread(

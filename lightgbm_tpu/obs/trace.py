@@ -29,10 +29,24 @@ from .registry import MetricsRegistry, get_registry
 
 
 class EventStream:
-    """Thread-safe JSON-lines sink (a file path or an open handle)."""
+    """Thread-safe JSON-lines sink (a file path or an open handle).
 
-    def __init__(self, path_or_fh):
+    Every record carries ``ts`` (wall clock) and ``seq`` — a per-stream
+    monotonic counter assigned under the write lock.  ``seq`` is what
+    ``tools/merge_events.py`` tie-breaks on when zipping streams from
+    hosts with skewed clocks: wall time orders ACROSS streams, the
+    monotonic counter orders WITHIN one.  ``static_fields`` (e.g.
+    ``process``/``host`` in distributed runs) are stamped onto every
+    record; ``ring`` is an optional flight recorder (anything with
+    ``append``) that sees each record after it is written.
+    """
+
+    def __init__(self, path_or_fh, static_fields: Optional[Dict] = None,
+                 ring=None):
         self._lock = threading.Lock()
+        self._static = dict(static_fields or {})
+        self._ring = ring
+        self._seq = 0
         if hasattr(path_or_fh, "write"):
             self._fh = path_or_fh
             self._owns = False
@@ -42,14 +56,34 @@ class EventStream:
 
     def write(self, event: str, **fields) -> Dict:
         rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(self._static)
         rec.update(fields)
-        line = json.dumps(rec, sort_keys=True, default=str) + "\n"
         with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            line = json.dumps(rec, sort_keys=True, default=str) + "\n"
             self._fh.write(line)
             self._fh.flush()
+        if self._ring is not None:
+            self._ring.append(rec)
         return rec
 
+    def flush(self, fsync: bool = False) -> None:
+        """Push buffered lines to the OS and, with ``fsync=True``, to
+        disk — called from the crash paths (HealthMonitor abort, the
+        checkpoint SIGTERM latch, the flight recorder's dump) so the
+        final events before a kill are never lost."""
+        with self._lock:
+            try:
+                self._fh.flush()
+                if fsync:
+                    import os
+                    os.fsync(self._fh.fileno())
+            except (OSError, ValueError):
+                pass   # closed handle / non-file sink: nothing to sync
+
     def close(self) -> None:
+        self.flush(fsync=self._owns)
         with self._lock:
             if self._owns:
                 self._fh.close()

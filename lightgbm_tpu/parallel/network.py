@@ -111,6 +111,72 @@ class JaxHostComm(HostComm):
                 for i in range(len(lengths))]
 
 
+class KvHostComm(HostComm):
+    """Host allgather over the jax.distributed coordination-service
+    key-value store — no compiled computation at all, which matters
+    because the CPU backend cannot run cross-process computations
+    (``process_allgather`` raises "Multiprocess computations aren't
+    implemented on the CPU backend"), yet the coordination service is up
+    on every backend the moment ``jax.distributed.initialize`` returns.
+
+    Protocol: each rank sets ``<ns>/r<round>/p<rank>`` to its
+    base64-pickled payload, then blocking-gets every rank's key (the
+    blocking get IS the synchronization — no separate barrier).  The
+    round counter namespaces keys so consecutive allgathers never read a
+    stale value; calls must therefore be SPMD-lockstep across processes
+    (same construction order, same call count), which is exactly how the
+    distributed-obs per-block cadence drives it.  Keys from two rounds
+    back are best-effort deleted to keep the coordinator's store bounded.
+    """
+
+    def __init__(self, namespace: str = "lgbm_hostcomm",
+                 timeout_ms: int = 60000):
+        self._ns = str(namespace)
+        self._timeout_ms = int(timeout_ms)
+        self._round = 0
+
+    def allgather(self, obj):
+        import base64
+        import pickle
+        import jax
+        from jax._src import distributed as _jdist
+        client = getattr(_jdist.global_state, "client", None)
+        if client is None:
+            raise LightGBMError(
+                "KvHostComm needs jax.distributed to be initialized")
+        n = int(jax.process_count())
+        me = int(jax.process_index())
+        r = self._round
+        self._round += 1
+        keyfmt = "%s/r%d/p%%d" % (self._ns, r)
+        blob = base64.b64encode(pickle.dumps(obj)).decode("ascii")
+        client.key_value_set(keyfmt % me, blob)
+        out = []
+        for p in range(n):
+            raw = client.blocking_key_value_get(keyfmt % p, self._timeout_ms)
+            out.append(pickle.loads(base64.b64decode(raw)))
+        if r >= 2:   # GC our own key from two rounds back
+            try:
+                client.key_value_delete("%s/r%d/p%d" % (self._ns, r - 2, me))
+            except Exception:
+                pass
+        return out
+
+
+def default_host_comm(namespace: str = "lgbm_hostcomm",
+                      timeout_ms: int = 60000) -> Optional[HostComm]:
+    """The right host-metadata allgather for the current topology: None
+    single-process, the coordination-service KV comm on the CPU backend
+    (which cannot run multiprocess computations), ``process_allgather``
+    everywhere else (TPU/GPU meshes)."""
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    if jax.default_backend() == "cpu":
+        return KvHostComm(namespace=namespace, timeout_ms=timeout_ms)
+    return JaxHostComm()
+
+
 class LoopbackComm(HostComm):
     """Test double: K simulated hosts as K threads in one process, with a
     barrier-synchronized allgather — the collective semantics are real

@@ -1,0 +1,292 @@
+"""Distributed telemetry (obs/distributed.py) and its satellite
+hardening: metric federation over an injected loopback comm, straggler
+skew math + HealthMonitor routing, the flight recorder's ring/dump/hook
+lifecycle, EventStream concurrency + crash flushing, Histogram.quantile
+edge cases, and the merge_events k-way timeline merge — all without a
+cluster (tools/dist_obs_smoke.py covers the real 2-process run)."""
+import importlib.util
+import json
+import os
+import signal
+import sys
+import threading
+
+import pytest
+
+from lightgbm_tpu.obs.distributed import (DistributedObs, FlightRecorder,
+                                          merge_prometheus_texts,
+                                          straggler_skew)
+from lightgbm_tpu.obs.health import HealthMonitor
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.obs.trace import EventStream
+from lightgbm_tpu.parallel.network import LoopbackComm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------- Histogram.quantile
+class TestHistogramQuantileEdges:
+    def _hist(self, bounds=(1.0, 2.0)):
+        return MetricsRegistry().histogram("h_edge", "t", buckets=bounds)
+
+    def test_empty_returns_zero(self):
+        assert self._hist().quantile(0.5) == 0.0
+
+    def test_nonfinite_q_raises(self):
+        h = self._hist()
+        h.observe(0.5)
+        for bad in (float("nan"), float("inf"), float("-inf")):
+            with pytest.raises(ValueError):
+                h.quantile(bad)
+
+    def test_single_observation_first_bucket_finite(self):
+        h = self._hist()
+        h.observe(0.5)
+        v = h.quantile(0.5)
+        assert v == v and 0.0 <= v <= 1.0   # finite, inside [0, bounds[0]]
+
+    def test_all_in_first_bucket_interpolates_from_zero(self):
+        h = self._hist()
+        for _ in range(4):
+            h.observe(0.25)
+        # rank 2 of 4 inside [0, 1]: halfway through the owning bucket
+        assert h.quantile(0.5) == 0.5
+        assert h.quantile(0.0) == 0.0
+        assert h.quantile(1.0) == 1.0
+
+    def test_overflow_bucket_clamps_to_last_bound(self):
+        h = self._hist()
+        h.observe(100.0)
+        assert h.quantile(0.5) == 2.0
+        assert h.quantile(0.99) == 2.0
+
+    def test_q_clamped_to_unit_interval(self):
+        h = self._hist()
+        for v in (0.5, 1.5, 99.0):
+            h.observe(v)
+        assert h.quantile(1.5) == h.quantile(1.0)
+        assert h.quantile(-0.5) == h.quantile(0.0)
+
+
+# ------------------------------------------------- skew math + merging
+def test_straggler_skew_math():
+    assert straggler_skew([]) == (1.0, -1)
+    skew, arg = straggler_skew([1.0, 1.0, 1.0, 3.0])
+    assert skew == 3.0 and arg == 3
+    assert straggler_skew([0.0, 0.0])[0] == 1.0       # degenerate median
+    assert straggler_skew([2.0, 2.0, 2.0])[0] == 1.0  # balanced
+
+
+def test_merge_prometheus_texts_dedupes_headers_keeps_series():
+    a = ('# HELP m total\n# TYPE m counter\n'
+         'm{host="a",process="0"} 1\n')
+    b = ('# HELP m total\n# TYPE m counter\n'
+         'm{host="b",process="1"} 2\n')
+    merged = merge_prometheus_texts([a, b])
+    assert merged.count("# HELP m total") == 1
+    assert merged.count("# TYPE m counter") == 1
+    assert 'process="0"' in merged and 'process="1"' in merged
+
+
+def test_registry_global_labels_injected_and_clearable():
+    reg = MetricsRegistry()
+    reg.counter("fed_total", "t").inc(3)
+    reg.set_global_labels({"process": "3", "host": "tpu-a"})
+    text = reg.prometheus_text()
+    assert 'process="3"' in text and 'host="tpu-a"' in text
+    keys = reg.snapshot()["metrics"]
+    assert any('process="3"' in k and "fed_total" in k for k in keys)
+    reg.set_global_labels(None)   # clearing restores the plain exposition
+    assert 'process="' not in reg.prometheus_text()
+    assert "fed_total 3" in reg.prometheus_text()
+
+
+# ------------------------------------------------- EventStream hardening
+def test_event_stream_static_fields_seq_and_ring(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    fr = FlightRecorder(path, process_index=0, size=8)
+    es = EventStream(path, static_fields={"process": 1, "host": "h"},
+                     ring=fr)
+    es.write("a", x=1)
+    es.write("b")
+    es.flush(fsync=True)
+    es.close()
+    recs = [json.loads(ln) for ln in open(path)]
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert all(r["process"] == 1 and r["host"] == "h" for r in recs)
+    assert len(fr) == 2   # every written record mirrored into the ring
+
+
+def test_event_stream_concurrent_writers(tmp_path):
+    path = str(tmp_path / "conc.jsonl")
+    es = EventStream(path)
+    n_threads, per = 8, 50
+
+    def w(tid):
+        for i in range(per):
+            es.write("tick", tid=tid, i=i)
+
+    threads = [threading.Thread(target=w, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    es.close()
+    recs = [json.loads(ln) for ln in open(path)]   # every line parses
+    assert len(recs) == n_threads * per
+    seqs = sorted(r["seq"] for r in recs)
+    assert seqs == list(range(n_threads * per))    # unique + contiguous
+
+
+# ------------------------------------------------- FlightRecorder
+def test_flight_recorder_ring_bound_and_dump(tmp_path):
+    base = str(tmp_path / "ev.jsonl")
+    dumped = []
+    fr = FlightRecorder(base, process_index=2, size=4,
+                        on_dump=lambda reason: dumped.append(reason))
+    for i in range(10):
+        fr.record("tick", i=i)
+    assert len(fr) == 4                      # bounded ring
+    path = fr.dump("unit")
+    assert path == base + ".2.crash.jsonl" and os.path.exists(path)
+    assert dumped == ["unit"]
+    lines = [json.loads(ln) for ln in open(path)]
+    hdr = lines[0]
+    assert hdr["event"] == "flight_recorder_dump"
+    assert hdr["reason"] == "unit" and hdr["process"] == 2
+    assert hdr["entries"] == 4 and len(lines) == 5
+    assert [r["i"] for r in lines[1:]] == [6, 7, 8, 9]   # newest kept
+    # the dump latches: a second reason never truncates the first
+    fr.record("late")
+    assert fr.dump("second") == path
+    assert json.loads(open(path).readline())["reason"] == "unit"
+
+
+def test_flight_recorder_install_uninstall_restores_hooks(tmp_path):
+    fr = FlightRecorder(str(tmp_path / "e.jsonl"))
+    prev_hook = sys.excepthook
+    prev_sig = signal.getsignal(signal.SIGTERM)
+    fr.install()
+    assert sys.excepthook == fr._excepthook
+    assert signal.getsignal(signal.SIGTERM) == fr._on_sigterm
+    fr.uninstall()
+    assert sys.excepthook == prev_hook
+    assert signal.getsignal(signal.SIGTERM) == prev_sig
+
+
+# ------------------------------------------------- HealthMonitor routing
+def test_note_straggler_never_escalates():
+    reg = MetricsRegistry()
+    mon = HealthMonitor(action="raise", registry=reg)   # harshest action
+    r = mon.note_straggler(iteration=7, process=3, skew=2.5,
+                           threshold=2.0)
+    assert r.kind == "straggler_wave" and r in mon.reports
+    keys = reg.snapshot()["metrics"]
+    assert keys.get("lgbm_train_straggler_reports_total") == 1
+
+
+# ------------------------------------------------- DistributedObs
+def _fake_cluster(busies, warn_skew=1.5, waves=8.0):
+    """K fake processes as threads over a LoopbackComm: returns
+    (docs, dists, monitors)."""
+    k = len(busies)
+    comms = LoopbackComm.group(k)
+    regs = [MetricsRegistry() for _ in range(k)]
+    monitors = [HealthMonitor(action="warn", registry=regs[i])
+                for i in range(k)]
+    dists = [DistributedObs(registry=regs[i], monitor=monitors[i],
+                            comm=comms[i], process_index=i,
+                            process_count=k, hostname="host%d" % i,
+                            warn_skew=warn_skew)
+             for i in range(k)]
+    docs = [None] * k
+
+    def run(r):
+        docs[r] = dists[r].on_block(0, 4, busies[r], 0.01, waves=waves)
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(k)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return docs, dists, monitors
+
+
+def test_distributed_obs_federates_and_flags_straggler():
+    docs, dists, monitors = _fake_cluster([0.05, 0.50], warn_skew=1.5)
+    for r, doc in enumerate(docs):
+        assert doc is not None, "rank %d allgather failed" % r
+        assert sorted(doc["processes"]) == ["0", "1"]
+        assert doc["straggler"]["process"] == 1
+        assert doc["straggler"]["skew"] >= 1.5
+        # every rank's per-process snapshot carries its federation labels
+        keys = doc["processes"][str(r)]["metrics"]
+        assert any('process="%d"' % r in k for k in keys)
+    # both ranks agree on the cluster view and serve it from the cache
+    assert docs[0]["straggler"] == docs[1]["straggler"]
+    for r, d in enumerate(dists):
+        assert d.cluster_stats()["straggler"] == docs[0]["straggler"]
+        prom = d.cluster_prometheus()
+        assert 'process="0"' in prom and 'process="1"' in prom
+        # the skew crossing routed through THIS rank's monitor
+        assert any(rep.kind == "straggler_wave"
+                   for rep in monitors[r].reports)
+
+
+def test_distributed_obs_balanced_cluster_stays_quiet():
+    docs, _dists, monitors = _fake_cluster([0.2, 0.2], warn_skew=1.5)
+    for doc in docs:
+        assert doc["straggler"]["skew"] < 1.5
+    for mon in monitors:
+        assert not any(r.kind == "straggler_wave" for r in mon.reports)
+
+
+def test_distributed_obs_single_process_degenerate():
+    reg = MetricsRegistry()
+    d = DistributedObs(registry=reg, comm=None, process_index=0,
+                       process_count=1, hostname="solo")
+    assert d.on_block(0, 4, 0.1, 0.2, waves=4.0) is None
+    snap = d.cluster_stats()
+    assert snap["metrics"] == reg.snapshot()["metrics"]   # exactly local
+    assert d.cluster_prometheus() == reg.prometheus_text()
+    assert snap["metrics"].get("lgbm_dist_allgathers_total", 0) == 0
+    assert snap["metrics"]["lgbm_wave_straggler_skew"] == 1.0
+    assert reg.global_labels() == {}    # no federation labels injected
+
+
+# ------------------------------------------------- merge_events
+def test_merge_events_orders_with_skewed_clocks(tmp_path):
+    me = _load_tool("merge_events")
+    s1 = tmp_path / "p0.jsonl"
+    s2 = tmp_path / "p1.jsonl"
+    # p0's clock steps BACKWARDS mid-stream; p1 ties p0 at ts=2.0
+    s1.write_text('{"ts": 1.0, "seq": 0, "event": "a"}\n'
+                  '{"ts": 3.0, "seq": 1, "event": "b"}\n'
+                  '{"ts": 2.5, "seq": 2, "event": "c"}\n')
+    s2.write_text('{"ts": 2.0, "seq": 0, "event": "x"}\n'
+                  '{"ts": 2.0, "seq": 1, "event": "y"}\n'
+                  '{"ts": 4.0, "seq": 2, "event": "z"}\n')
+    merged = list(me.merge([str(s1), str(s2)]))
+    assert [r["event"] for r in merged] == ["a", "x", "y", "b", "c", "z"]
+    # in-stream order survives the backwards clock ("c" stays after "b")
+    p0 = [r["event"] for r in merged if r["stream"] == "p0.jsonl"]
+    assert p0 == ["a", "b", "c"]
+    assert all("stream" in r for r in merged)
+
+
+def test_merge_events_skips_malformed_lines(tmp_path):
+    me = _load_tool("merge_events")
+    s = tmp_path / "torn.jsonl"
+    s.write_text('{"ts": 1.0, "seq": 0, "event": "ok"}\n'
+                 '{"ts": 2.0, "seq": 1, "ev')   # torn final line (SIGKILL)
+    merged = list(me.merge([str(s)]))
+    assert [r["event"] for r in merged] == ["ok"]

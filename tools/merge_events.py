@@ -1,0 +1,146 @@
+"""Merge per-process JSON-lines event streams into one timeline.
+
+Distributed runs write one ``obs_event_file`` per process (each record
+stamped with ``process``/``host`` static fields plus a per-stream ``seq``)
+and, on a crash, one ``<obs_event_file>.<process>.crash.jsonl`` flight
+recorder dump per process.  This tool zips any number of those streams
+into a single time-ordered ``timeline.jsonl``:
+
+- **k-way head merge**: streams are consumed through a heap that only
+  ever compares the current HEAD of each stream, so records within one
+  stream always keep their original order even when that stream's clock
+  jumps backwards (NTP step, container migration) — cross-stream order
+  is by wall clock, in-stream order is authoritative.
+- **monotonic tie-break**: equal timestamps order by the stream's own
+  ``seq`` (the EventStream's monotonic per-process counter), then by
+  stream name, so the merge is deterministic across runs and platforms.
+- every output record gains a ``stream`` field (the source file's
+  basename) so a merged timeline still attributes each line.
+
+Usage::
+
+    python tools/merge_events.py out/events.*.jsonl --out timeline.jsonl
+
+Exit 0 on success; malformed lines are counted, reported on stderr and
+skipped (a torn final line from a SIGKILL'd process must not sink the
+whole post-mortem).
+"""
+import argparse
+import heapq
+import json
+import os
+import sys
+from typing import Iterator, List, Optional, TextIO, Tuple
+
+
+def _records(fh: TextIO, stream: str):
+    """Yield parsed records; count (don't raise on) malformed lines."""
+    for lineno, line in enumerate(fh, 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            yield None, (stream, lineno)
+            continue
+        if not isinstance(rec, dict):
+            yield None, (stream, lineno)
+            continue
+        yield rec, None
+
+
+class _Stream:
+    """One input file: exposes head-record sort keys for the heap."""
+
+    def __init__(self, path: str):
+        self.name = os.path.basename(path)
+        self._fh = open(path)
+        self._it = _records(self._fh, self.name)
+        self.head: Optional[dict] = None
+        self.bad: List[Tuple[str, int]] = []
+        self._advance()
+
+    def _advance(self) -> None:
+        for rec, err in self._it:
+            if err is not None:
+                self.bad.append(err)
+                continue
+            self.head = rec
+            return
+        self.head = None
+        self._fh.close()
+
+    def key(self) -> Tuple[float, int, str]:
+        rec = self.head or {}
+        try:
+            ts = float(rec.get("ts", 0.0))
+        except (TypeError, ValueError):
+            ts = 0.0
+        try:
+            seq = int(rec.get("seq", -1))
+        except (TypeError, ValueError):
+            seq = -1
+        return ts, seq, self.name
+
+    def pop(self) -> dict:
+        rec = self.head
+        self._advance()
+        return rec
+
+
+def merge(paths: List[str]) -> Iterator[dict]:
+    """Yield records from ``paths`` time-ordered (see module docstring).
+    Each yielded record carries a ``stream`` field."""
+    streams = [_Stream(p) for p in paths]
+    heap = [(s.key(), i) for i, s in enumerate(streams)
+            if s.head is not None]
+    heapq.heapify(heap)
+    while heap:
+        _key, i = heapq.heappop(heap)
+        s = streams[i]
+        rec = s.pop()
+        rec["stream"] = s.name
+        yield rec
+        if s.head is not None:
+            heapq.heappush(heap, (s.key(), i))
+    bad = [b for s in streams for b in s.bad]
+    if bad:
+        for stream, lineno in bad[:10]:
+            print("merge_events: skipped malformed line %s:%d"
+                  % (stream, lineno), file=sys.stderr)
+        if len(bad) > 10:
+            print("merge_events: ... and %d more" % (len(bad) - 10),
+                  file=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process obs event streams into one "
+                    "time-ordered timeline")
+    ap.add_argument("inputs", nargs="+",
+                    help="JSON-lines event files (streams + crash dumps)")
+    ap.add_argument("--out", default="-",
+                    help="output path (default: stdout)")
+    args = ap.parse_args()
+    for p in args.inputs:
+        if not os.path.exists(p):
+            print("merge_events: no such file: %s" % p, file=sys.stderr)
+            return 2
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    n = 0
+    try:
+        for rec in merge(args.inputs):
+            out.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+            n += 1
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    print("merge_events: %d record(s) from %d stream(s)%s"
+          % (n, len(args.inputs),
+             "" if args.out == "-" else " -> %s" % args.out),
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

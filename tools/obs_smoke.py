@@ -109,6 +109,22 @@ def main() -> int:
         hz = json.loads(_scrape(port, "/healthz"))
         check(hz.get("status") == "ok" and hz.get("anomalies") == 0,
               "/healthz reports ok with zero anomalies")
+        # ---- single-process degenerate case (obs/distributed.py) -------
+        # the cluster routes must serve exactly the local view, with no
+        # DistributedObs constructed and no host allgather ever issued
+        check(obs.dist is None,
+              "no DistributedObs constructed single-process (auto mode)")
+        prom_local = _scrape(port, "/metrics")
+        prom_cluster = _scrape(port, "/metrics/cluster")
+        check(prom_cluster == prom_local,
+              "/metrics/cluster byte-equal to /metrics single-process")
+        snap_cluster = json.loads(_scrape(port, "/stats/cluster"))
+        check(snap_cluster.get("metrics") == snap.get("metrics"),
+              "/stats/cluster metrics map identical to /stats")
+        check("lgbm_dist_allgathers_total" not in snap.get("metrics", {}),
+              "no allgather counter registered (none issued)")
+        check('process="' not in prom_local.decode(),
+              "no process= federation label single-process")
         scraped = {"port": port, "healthz": hz,
                    "prom_lines": len(prom.splitlines())}
         obs.stats.stop()
