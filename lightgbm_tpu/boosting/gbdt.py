@@ -43,6 +43,7 @@ from ..core.pack import pack_trees, unpack_tree
 from ..core import tree as tree_mod
 from ..objectives import ObjectiveFunction
 from ..metrics import Metric
+from ..resilience import faults as _faults
 
 
 class HostTree:
@@ -213,6 +214,12 @@ class GBDT:
                  objective: Optional[ObjectiveFunction],
                  metrics: Optional[List[Metric]] = None):
         self.config = config
+        if getattr(config, "fault_inject", ""):
+            # arm the deterministic fault plan (docs/Resilience.md) before
+            # any seam can fire; identical (spec, seed) re-installs keep
+            # fire counts across in-process supervised restarts
+            from ..resilience import faults
+            faults.install_plan(config.fault_inject, config.fault_seed)
         if getattr(config, "compile_cache_dir", ""):
             # persistent XLA compile cache: wired before the first jit so
             # every executable this booster builds is cacheable — warm
@@ -1324,6 +1331,11 @@ class GBDT:
         done = 0
         while done < num_iters and not self._stopped:
             block = min(num_iters - done, 64)
+            # train_dispatch seam (docs/Resilience.md): fires before the
+            # block is dispatched; iteration = block start, round = the
+            # per-point block ordinal. Two attribute checks when inert.
+            _faults.inject("train_dispatch", iteration=self.iter_,
+                           block_len=block)
             self._last_block_len = block
             obs = self.obs
             # host window opens before feature sampling: mask/bag-key prep
@@ -1548,6 +1560,7 @@ class GBDT:
         """
         if self._stopped:
             return True
+        _faults.inject("train_dispatch", iteration=self.iter_)
         self._boost_from_average()
         self._maybe_warm_ladder()
         if self._compiled_iter is None:

@@ -157,6 +157,12 @@ def write_snapshot(directory: str, snap_id: int, meta: Dict[str, Any],
 
     sha = {name: sha256_file(os.path.join(directory, name))
            for name in (state_name, meta_name, model_name)}
+    # ckpt_write seam (docs/Resilience.md): a ckpt_torn fault truncates
+    # the state file AFTER its sha was computed — exactly a torn write —
+    # so the manifest check catches it and resume falls back a snapshot
+    from ..resilience import faults
+    faults.inject("ckpt_write", snapshot=int(snap_id),
+                  path=os.path.join(directory, state_name))
     return {"id": int(snap_id),
             "iteration": int(meta.get("iteration", snap_id)),
             "files": {"state": state_name, "meta": meta_name,

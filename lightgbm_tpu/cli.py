@@ -143,7 +143,8 @@ def run_train(config: Config, params: Dict) -> None:
                                if config.early_stopping_round > 0 else None),
         verbose_eval=False,
         callbacks=callbacks or None,
-        resume_from=(config.resume or None))
+        resume_from=(config.resume or None),
+        supervise=(config.supervise or None))
     booster.save_model(config.output_model)
     Log.info("Finished training; model saved to %s", config.output_model)
     obs = getattr(booster._impl, "obs", None)

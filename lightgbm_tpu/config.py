@@ -261,6 +261,48 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # and dumped to <obs_event_file>.<process>.crash.jsonl on HealthMonitor
     # abort, SIGTERM, or unhandled exception; 0 = off
     ("obs_flight_recorder", int, 512, ["obs_flight_recorder_size"]),
+    # ---- resilience (lightgbm_tpu.resilience; docs/Resilience.md) ----
+    # deterministic fault plan: comma list of kind@unit:match[:arg], e.g.
+    # "kv_timeout@round:2,kill@iter:7,serve_error@req:50". Strictly
+    # host-side; "" (default) = injection fully inert.
+    ("fault_inject", str, "", ["fault_plan"]),
+    ("fault_seed", int, 0, []),
+    # supervised training: watchdog + auto-resume restart loop around the
+    # boosting loop (needs checkpoint_dir for somewhere to resume from)
+    ("supervise", bool, False, ["supervised"]),
+    ("supervise_max_restarts", int, 3, ["max_restarts"]),
+    ("supervise_backoff_s", float, 1.0, []),
+    ("supervise_backoff_max_s", float, 60.0, []),
+    # hung-dispatch watchdog deadline (seconds); 0 = no watchdog. The
+    # FIRST deadline adds supervise_warmup_grace_s: the initial compile
+    # is slow-but-alive and must not false-fire.
+    ("supervise_hang_timeout_s", float, 0.0, ["hang_timeout_s"]),
+    ("supervise_warmup_grace_s", float, 120.0, []),
+    # heartbeat file touched every iteration for an external process-level
+    # supervisor (tools/chaos_smoke.py); "" = off
+    ("supervise_heartbeat_file", str, "", ["heartbeat_file"]),
+    # KvHostComm robustness: bounded retry-with-backoff on transient
+    # coordination-service set/get failures before surfacing
+    ("kv_retries", int, 3, []),
+    ("kv_retry_backoff_s", float, 0.25, []),
+    # KV heartbeat leases for peer-death detection (multi-process): each
+    # rank re-leases every period_s; a peer silent past lease_s is dead
+    ("kv_heartbeat_period_s", float, 2.0, []),
+    ("kv_heartbeat_lease_s", float, 10.0, []),
+    # serving overload protection: bounded admission in ROWS (0 = no
+    # bound), per-request deadline in ms (0 = none)
+    ("serve_max_queue_rows", int, 0, []),
+    ("serve_request_timeout_ms", float, 0.0, []),
+    # consecutive dispatch failures that trip the serving circuit breaker
+    # to 503+Retry-After (0 disables); cooldown before a half-open probe
+    ("serve_breaker_failures", int, 5, ["serve_breaker_threshold"]),
+    ("serve_breaker_cooldown_s", float, 5.0, []),
+    # guarded hot-roll: score canary rows on a staged bundle (finite
+    # outputs, traversal-vs-replay parity, optional latency cap) and
+    # refuse the swap on failure, keeping the prior generation live
+    ("serve_guard_hot_roll", bool, True, ["serve_guarded_roll"]),
+    ("serve_canary_rows", int, 16, []),
+    ("serve_roll_max_latency_ms", float, 0.0, []),   # 0 = no latency gate
 ]
 
 # known spellings, validated in _post_process (a typo'd kernel or growth
@@ -524,6 +566,53 @@ class Config:
         if self.serving_cascade_margin < 0:
             raise LightGBMError("serving_cascade_margin should be >= 0, "
                                 "got %s" % self.serving_cascade_margin)
+        # fault plans parse at config time — a typo'd kind must fail here,
+        # not silently never fire mid-chaos-run
+        if self.fault_inject:
+            from .resilience import faults as _faults
+            _faults.parse_plan(self.fault_inject, self.fault_seed)
+        if self.supervise_max_restarts < 0:
+            raise LightGBMError("supervise_max_restarts should be >= 0, "
+                                "got %s" % self.supervise_max_restarts)
+        if self.supervise_backoff_s < 0 or self.supervise_backoff_max_s < 0:
+            raise LightGBMError(
+                "supervise_backoff_s/supervise_backoff_max_s should be >= 0")
+        if self.supervise_hang_timeout_s < 0 or \
+                self.supervise_warmup_grace_s < 0:
+            raise LightGBMError(
+                "supervise_hang_timeout_s/supervise_warmup_grace_s should "
+                "be >= 0 (0 = no watchdog)")
+        if self.kv_retries < 0:
+            raise LightGBMError("kv_retries should be >= 0, got %s"
+                                % self.kv_retries)
+        if self.kv_retry_backoff_s < 0:
+            raise LightGBMError("kv_retry_backoff_s should be >= 0, got %s"
+                                % self.kv_retry_backoff_s)
+        if self.kv_heartbeat_period_s <= 0 or self.kv_heartbeat_lease_s <= 0:
+            raise LightGBMError(
+                "kv_heartbeat_period_s/kv_heartbeat_lease_s should be > 0")
+        if self.serve_max_queue_rows < 0:
+            raise LightGBMError("serve_max_queue_rows should be >= 0 "
+                                "(0 = unbounded), got %s"
+                                % self.serve_max_queue_rows)
+        if self.serve_request_timeout_ms < 0:
+            raise LightGBMError("serve_request_timeout_ms should be >= 0 "
+                                "(0 = none), got %s"
+                                % self.serve_request_timeout_ms)
+        if self.serve_breaker_failures < 0:
+            raise LightGBMError("serve_breaker_failures should be >= 0 "
+                                "(0 disables), got %s"
+                                % self.serve_breaker_failures)
+        if self.serve_breaker_cooldown_s < 0:
+            raise LightGBMError("serve_breaker_cooldown_s should be >= 0, "
+                                "got %s" % self.serve_breaker_cooldown_s)
+        if self.serve_canary_rows < 1:
+            raise LightGBMError("serve_canary_rows should be >= 1, got %s"
+                                % self.serve_canary_rows)
+        if self.serve_roll_max_latency_ms < 0:
+            raise LightGBMError("serve_roll_max_latency_ms should be >= 0 "
+                                "(0 = no latency gate), got %s"
+                                % self.serve_roll_max_latency_ms)
         # verbosity drives the process logger unconditionally so
         # verbosity=-1 (fatal-only) also silences obs warnings; previously
         # negative values were dropped and warnings leaked through

@@ -34,13 +34,84 @@ def train(params: Dict[str, Any], train_set: Dataset,
           learning_rates: Optional[Union[List[float], Callable]] = None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
-          resume_from: Optional[str] = None) -> Booster:
+          resume_from: Optional[str] = None,
+          supervise: Optional[bool] = None) -> Booster:
     """engine.py:19 — train with the reference's full signature, plus
     ``resume_from``: a lightgbm_tpu.checkpoint directory to continue from
     (``num_boost_round`` stays the TOTAL target — a run checkpointed at
     iteration k trains the remaining ``num_boost_round - k`` rounds and
     produces a model byte-identical to the uninterrupted run;
-    docs/Checkpointing.md)."""
+    docs/Checkpointing.md), and ``supervise`` (or ``supervise=true`` in
+    params): run under the resilience supervisor — a watchdog over the
+    per-iteration heartbeat (``supervise_hang_timeout_s``; warmup-aware
+    so a slow first compile never false-fires) plus a restart loop that
+    flight-dumps on crash and auto-resumes from the newest valid
+    checkpoint under bounded exponential backoff, byte-identical to the
+    uninterrupted run (docs/Resilience.md)."""
+    if supervise is None:
+        raw = (params or {}).get("supervise",
+                                 (params or {}).get("supervised", False))
+        supervise = str(raw).strip().lower() in ("true", "1", "yes", "+")
+    if supervise:
+        return _train_supervised(
+            params, train_set, num_boost_round, valid_sets, valid_names,
+            fobj, feval, init_model, feature_name, categorical_feature,
+            early_stopping_rounds, evals_result, verbose_eval,
+            learning_rates, keep_training_booster, callbacks, resume_from)
+    return _train_once(
+        params, train_set, num_boost_round, valid_sets, valid_names, fobj,
+        feval, init_model, feature_name, categorical_feature,
+        early_stopping_rounds, evals_result, verbose_eval, learning_rates,
+        keep_training_booster, callbacks, resume_from)
+
+
+def _train_supervised(params, train_set, num_boost_round, valid_sets,
+                      valid_names, fobj, feval, init_model, feature_name,
+                      categorical_feature, early_stopping_rounds,
+                      evals_result, verbose_eval, learning_rates,
+                      keep_training_booster, callbacks,
+                      resume_from) -> Booster:
+    from .resilience.supervisor import Supervisor, heartbeat_file_callback
+    cfg = Config(copy.deepcopy(params) if params else {})
+    sup = Supervisor(cfg.checkpoint_dir,
+                     max_restarts=cfg.supervise_max_restarts,
+                     backoff_s=cfg.supervise_backoff_s,
+                     backoff_max_s=cfg.supervise_backoff_max_s,
+                     hang_timeout_s=cfg.supervise_hang_timeout_s,
+                     warmup_grace_s=cfg.supervise_warmup_grace_s)
+
+    def attempt(resume, watchdog):
+        cbs = list(callbacks or [])
+        if watchdog is not None:
+            cbs.append(watchdog.callback())
+        if cfg.supervise_heartbeat_file:
+            cbs.append(heartbeat_file_callback(cfg.supervise_heartbeat_file))
+        return _train_once(
+            params, train_set, num_boost_round, valid_sets, valid_names,
+            fobj, feval, init_model, feature_name, categorical_feature,
+            early_stopping_rounds, evals_result, verbose_eval,
+            learning_rates, keep_training_booster, cbs,
+            resume if resume is not None else resume_from)
+
+    return sup.run(attempt)
+
+
+def _train_once(params: Dict[str, Any], train_set: Dataset,
+                num_boost_round: int = 100,
+                valid_sets: Optional[List[Dataset]] = None,
+                valid_names: Optional[List[str]] = None,
+                fobj: Optional[Callable] = None,
+                feval: Optional[Callable] = None,
+                init_model: Optional[Union[str, Booster]] = None,
+                feature_name: Union[str, List[str]] = "auto",
+                categorical_feature: Union[str, List] = "auto",
+                early_stopping_rounds: Optional[int] = None,
+                evals_result: Optional[Dict] = None,
+                verbose_eval: Union[bool, int] = True,
+                learning_rates: Optional[Union[List[float], Callable]] = None,
+                keep_training_booster: bool = False,
+                callbacks: Optional[List[Callable]] = None,
+                resume_from: Optional[str] = None) -> Booster:
     params = copy.deepcopy(params) if params else {}
     # resolve num_boost_round aliases out of params (engine.py:96-107)
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
@@ -136,47 +207,66 @@ def train(params: Dict[str, Any], train_set: Dataset,
             num_boost_round = max(num_boost_round - completed, 0)
             resumed = True
 
-    # boosting loop (engine.py:211-246)
+    # boosting loop (engine.py:211-246); a crash anywhere in it triggers
+    # a flight-recorder dump (when armed) and the dump path rides the
+    # exception for the supervisor / operator
     init_iteration = booster.current_iteration
     finished_early = False
     evaluation_result_list = []
-    if valid_sets is None and fobj is None and not cbs_before and \
-            not resumed and \
-            all(getattr(c, "only_consumes_evals", False) for c in cbs_after):
-        # nothing needs the host between iterations (eval-display callbacks
-        # are no-ops with no valid sets): fuse the whole loop into
-        # on-device blocks (GBDT.train_many)
-        booster._impl.train_many(num_boost_round)
-        num_boost_round = 0
-    for i in range(init_iteration, init_iteration + num_boost_round):
-        for cb in cbs_before:
-            cb(callback.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=init_iteration,
-                end_iteration=init_iteration + num_boost_round,
-                evaluation_result_list=None))
-        stopped = booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if valid_sets is not None or cbs_after:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            if valid_sets is not None and booster._valid_sets:
-                evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in cbs_after:
+    try:
+        if valid_sets is None and fobj is None and not cbs_before and \
+                not resumed and \
+                all(getattr(c, "only_consumes_evals", False)
+                    for c in cbs_after):
+            # nothing needs the host between iterations (eval-display
+            # callbacks are no-ops with no valid sets): fuse the whole
+            # loop into on-device blocks (GBDT.train_many)
+            booster._impl.train_many(num_boost_round)
+            num_boost_round = 0
+        for i in range(init_iteration, init_iteration + num_boost_round):
+            for cb in cbs_before:
                 cb(callback.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=init_iteration,
                     end_iteration=init_iteration + num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback.EarlyStopException as earlyStopException:
-            booster.best_iteration = earlyStopException.best_iteration + 1
-            evaluation_result_list = earlyStopException.best_score
-            finished_early = True
-            break
-        if stopped:
-            break
+                    evaluation_result_list=None))
+            stopped = booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if valid_sets is not None or cbs_after:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                if valid_sets is not None and booster._valid_sets:
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cbs_after:
+                    cb(callback.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=init_iteration,
+                        end_iteration=init_iteration + num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback.EarlyStopException as earlyStopException:
+                booster.best_iteration = earlyStopException.best_iteration + 1
+                evaluation_result_list = earlyStopException.best_score
+                finished_early = True
+                break
+            if stopped:
+                break
+    except callback.EarlyStopException:
+        raise
+    except Exception as train_err:
+        obs = getattr(booster._impl, "obs", None)
+        if obs is not None and not getattr(train_err,
+                                           "flight_dump_path", None):
+            try:
+                dump = obs.crash_flush("train-exception: %s: %s"
+                                       % (type(train_err).__name__,
+                                          train_err))
+                if dump:
+                    train_err.flight_dump_path = dump
+            except Exception:   # the dump must never mask the crash
+                pass
+        raise
 
     booster.best_score = collections.defaultdict(dict)
     for dataset_name, eval_name, score, _ in (evaluation_result_list or []):

@@ -14,6 +14,7 @@ coordinator/process model.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional
 
 from ..log import Log, LightGBMError
@@ -130,34 +131,133 @@ class KvHostComm(HostComm):
     """
 
     def __init__(self, namespace: str = "lgbm_hostcomm",
-                 timeout_ms: int = 60000):
+                 timeout_ms: int = 60000, retries: int = 3,
+                 retry_backoff_s: float = 0.25, peer_guard=None,
+                 client=None, num_processes: Optional[int] = None,
+                 rank: Optional[int] = None):
         self._ns = str(namespace)
         self._timeout_ms = int(timeout_ms)
+        self._retries = max(int(retries), 0)
+        self._retry_backoff_s = max(float(retry_backoff_s), 0.0)
+        # peer_guard() -> list of dead peer ranks (KvHeartbeat.dead_peers);
+        # checked between poll slices so a dead rank fails in seconds, not
+        # after the full blocking-get timeout
+        self._peer_guard = peer_guard
+        self._client = client              # tests inject a dict-backed stub
+        self._n = num_processes
+        self._rank = rank
         self._round = 0
+
+    def _resolve(self):
+        if self._client is None:
+            from jax._src import distributed as _jdist
+            self._client = getattr(_jdist.global_state, "client", None)
+            if self._client is None:
+                raise LightGBMError(
+                    "KvHostComm needs jax.distributed to be initialized")
+        if self._n is None or self._rank is None:
+            import jax
+            self._n = int(jax.process_count())
+            self._rank = int(jax.process_index())
+        return self._client
+
+    @staticmethod
+    def _transient(err: Exception) -> bool:
+        """Coordination-service failures worth retrying; a timeout is NOT
+        transient — the peer is late or dead, retrying just re-waits."""
+        return "DEADLINE_EXCEEDED" not in str(err)
+
+    def _kv_set(self, key: str, value: str, r: int) -> None:
+        from ..resilience import faults
+        client = self._client
+        last: Optional[Exception] = None
+        for attempt in range(self._retries + 1):
+            try:
+                faults.inject("kv_set", round=r, rank=self._rank, key=key)
+                client.key_value_set(key, value)
+                return
+            except Exception as e:  # noqa: BLE001 - classify + retry below
+                if isinstance(e, LightGBMError):
+                    raise
+                last = e
+                if not self._transient(e) or attempt == self._retries:
+                    break
+                Log.warning("KvHostComm set %s failed (%s); retry %d/%d",
+                            key, e, attempt + 1, self._retries)
+                time.sleep(self._retry_backoff_s * (2 ** attempt))
+        raise LightGBMError(
+            "KvHostComm set failed: namespace=%s round=%d rank=%d key=%s "
+            "after %d attempt(s): %s"
+            % (self._ns, r, self._rank, key, self._retries + 1, last))
+
+    def _kv_get(self, key: str, r: int, peer: int) -> str:
+        from ..resilience import faults
+        client = self._client
+        deadline = time.monotonic() + self._timeout_ms / 1000.0
+        start = time.monotonic()
+        attempts = 0
+        last: Optional[Exception] = None
+        while True:
+            # short poll slices so the peer guard runs every ~2s even
+            # while the value is simply not there yet
+            slice_ms = min(max(int((deadline - time.monotonic()) * 1000), 1),
+                           2000)
+            attempts += 1
+            try:
+                faults.inject("kv_get", round=r, rank=self._rank,
+                              peer=peer, key=key)
+                return client.blocking_key_value_get(key, slice_ms)
+            except Exception as e:  # noqa: BLE001 - classify + retry below
+                if isinstance(e, LightGBMError):
+                    raise
+                last = e
+                elapsed_ms = (time.monotonic() - start) * 1000.0
+                if self._peer_guard is not None:
+                    try:
+                        dead = list(self._peer_guard())
+                    except Exception:
+                        dead = []
+                    if peer in dead:
+                        raise LightGBMError(
+                            "KvHostComm allgather: peer rank %d is DEAD "
+                            "(heartbeat lease expired) — namespace=%s "
+                            "round=%d rank=%d key=%s elapsed=%.0fms"
+                            % (peer, self._ns, r, self._rank, key,
+                               elapsed_ms)) from e
+                timed_out = time.monotonic() >= deadline
+                if not timed_out and self._transient(e) and \
+                        attempts <= self._retries:
+                    Log.warning("KvHostComm get %s failed (%s); retry "
+                                "%d/%d", key, e, attempts, self._retries)
+                    time.sleep(self._retry_backoff_s * (2 ** (attempts - 1)))
+                    continue
+                if not timed_out and "DEADLINE_EXCEEDED" in str(e):
+                    continue     # poll slice expired; keep waiting
+                raise LightGBMError(
+                    "KvHostComm allgather %s: namespace=%s round=%d "
+                    "rank=%d peer=%d key=%s elapsed=%.0fms attempts=%d: %s"
+                    % ("timed out" if timed_out else "failed",
+                       self._ns, r, self._rank, peer, key,
+                       elapsed_ms, attempts, last)) from e
 
     def allgather(self, obj):
         import base64
         import pickle
-        import jax
-        from jax._src import distributed as _jdist
-        client = getattr(_jdist.global_state, "client", None)
-        if client is None:
-            raise LightGBMError(
-                "KvHostComm needs jax.distributed to be initialized")
-        n = int(jax.process_count())
-        me = int(jax.process_index())
+        self._resolve()
+        n, me = self._n, self._rank
         r = self._round
         self._round += 1
         keyfmt = "%s/r%d/p%%d" % (self._ns, r)
         blob = base64.b64encode(pickle.dumps(obj)).decode("ascii")
-        client.key_value_set(keyfmt % me, blob)
+        self._kv_set(keyfmt % me, blob, r)
         out = []
         for p in range(n):
-            raw = client.blocking_key_value_get(keyfmt % p, self._timeout_ms)
+            raw = self._kv_get(keyfmt % p, r, p)
             out.append(pickle.loads(base64.b64decode(raw)))
         if r >= 2:   # GC our own key from two rounds back
             try:
-                client.key_value_delete("%s/r%d/p%d" % (self._ns, r - 2, me))
+                self._client.key_value_delete(
+                    "%s/r%d/p%d" % (self._ns, r - 2, me))
             except Exception:
                 pass
         return out
@@ -180,24 +280,55 @@ def default_host_comm(namespace: str = "lgbm_hostcomm",
 class LoopbackComm(HostComm):
     """Test double: K simulated hosts as K threads in one process, with a
     barrier-synchronized allgather — the collective semantics are real
-    (rank-ordered, lockstep) without any cluster."""
+    (rank-ordered, lockstep) without any cluster.
+
+    A simulated host that dies between the two waits used to hang every
+    other thread forever; ``abort()`` (call it from the dying rank's
+    except/finally) breaks the barrier so peers get a clean LightGBMError
+    instead, and ``timeout_s`` bounds the wait as a backstop."""
 
     def __init__(self, shared: dict, my_rank: int):
         self._shared = shared
         self._rank = my_rank
 
     @staticmethod
-    def group(k: int) -> List["LoopbackComm"]:
+    def group(k: int, timeout_s: Optional[float] = None) -> List["LoopbackComm"]:
         import threading
-        shared = {"slots": [None] * k, "barrier": threading.Barrier(k)}
+        shared = {"slots": [None] * k, "barrier": threading.Barrier(k),
+                  "timeout_s": timeout_s, "aborted_by": None}
         return [LoopbackComm(shared, r) for r in range(k)]
 
+    def abort(self) -> None:
+        """Mark this rank dead and break the barrier, unblocking peers."""
+        if self._shared.get("aborted_by") is None:
+            self._shared["aborted_by"] = self._rank
+        self._shared["barrier"].abort()
+
+    def _wait(self, phase: str) -> None:
+        import threading
+        try:
+            self._shared["barrier"].wait(self._shared.get("timeout_s"))
+        except threading.BrokenBarrierError:
+            culprit = self._shared.get("aborted_by")
+            raise LightGBMError(
+                "LoopbackComm allgather aborted at %s barrier on rank %d%s"
+                % (phase, self._rank,
+                   ": rank %d crashed" % culprit if culprit is not None
+                   else " (barrier broken or timed out)")) from None
+
     def allgather(self, obj):
-        self._shared["slots"][self._rank] = obj
-        self._shared["barrier"].wait()
-        out = list(self._shared["slots"])
-        self._shared["barrier"].wait()   # don't overwrite until all read
-        return out
+        try:
+            self._shared["slots"][self._rank] = obj
+            self._wait("publish")
+            out = list(self._shared["slots"])
+            self._wait("drain")   # don't overwrite until all read
+            return out
+        except LightGBMError:
+            raise
+        except BaseException:
+            # dying between the waits must not wedge the peers
+            self.abort()
+            raise
 
 
 class ExternalComm(HostComm):

@@ -1,0 +1,28 @@
+"""Fault tolerance: deterministic fault injection, supervised training,
+and serving overload protection (docs/Resilience.md).
+
+Three pillars, all strictly host-side (compiled programs are pinned
+byte-identical by ANALYSIS_BASELINE.json / PERF_COUNTERS.json):
+
+- ``faults``     — a seeded, config-driven fault plan
+  (``fault_inject="kv_timeout@round:2,kill@iter:7"``) with named
+  injection points threaded through the host seams; inert by default.
+- ``supervisor`` — watchdog + restart loop around ``engine.train``
+  (``supervise=True``), plus a process-level supervisor that survives
+  SIGKILL and true hangs, and KV heartbeat leases for peer-death
+  detection.
+- ``breaker``    — consecutive-failure circuit breaker for the serving
+  front-ends (503 + Retry-After, half-open probe).
+"""
+from .breaker import CircuitBreaker
+from .faults import (FaultPlan, WatchdogAbort, active_plan, clear_plan,
+                     inject, install_plan, parse_plan)
+from .supervisor import (ProcessSupervisor, Supervisor, Watchdog,
+                         heartbeat_file_callback)
+
+__all__ = [
+    "CircuitBreaker", "FaultPlan", "WatchdogAbort", "active_plan",
+    "clear_plan", "inject", "install_plan", "parse_plan",
+    "ProcessSupervisor", "Supervisor", "Watchdog",
+    "heartbeat_file_callback",
+]

@@ -82,8 +82,27 @@ class ServingMetrics:
             "lgbm_serving_errors_total", "Failed requests.", labels=lbl)
         self._g_queue = reg.gauge(
             "lgbm_serving_queue_depth",
-            "Micro-batch queue depth (gauge, set by the batch queue).",
+            "Micro-batch queue depth in REQUESTS (gauge, set by the batch "
+            "queue).", labels=lbl)
+        # queue depth in ROWS: dispatch sizing and the admission bound
+        # (serve_max_queue_rows) are row-based; a queue of 3 requests can
+        # be 3 rows or 12288 — report both
+        self._g_queue_rows = reg.gauge(
+            "lgbm_serve_queue_rows",
+            "Micro-batch queue depth in ROWS (gauge; the admission bound "
+            "serve_max_queue_rows applies to this).", labels=lbl)
+        self._c_shed = reg.counter(
+            "lgbm_serving_shed_total",
+            "Requests shed by bounded admission or open circuit breaker.",
             labels=lbl)
+        self._c_timeouts = reg.counter(
+            "lgbm_serving_request_timeouts_total",
+            "Requests expired past their per-request deadline before "
+            "dispatch.", labels=lbl)
+        self._c_rollbacks = reg.counter(
+            "lgbm_serving_rollbacks_total",
+            "Hot-rolls refused by canary validation (prior generation "
+            "kept live).", labels=lbl)
         # request latency is a HISTOGRAM (cumulative le-buckets), not a
         # summary: bucket counts aggregate across serving processes and
         # scrape intervals, which windowed quantiles cannot — Summary
@@ -131,6 +150,22 @@ class ServingMetrics:
     @property
     def queue_depth(self) -> int:
         return int(self._g_queue.value)
+
+    @property
+    def queue_rows(self) -> int:
+        return int(self._g_queue_rows.value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._c_shed.value)
+
+    @property
+    def request_timeouts(self) -> int:
+        return int(self._c_timeouts.value)
+
+    @property
+    def rollbacks(self) -> int:
+        return int(self._c_rollbacks.value)
 
     # ------------------------------------------------------------ recording
     def record_request(self, rows: int, latency_s: float) -> None:
@@ -183,6 +218,18 @@ class ServingMetrics:
     def set_queue_depth(self, depth: int) -> None:
         self._g_queue.set(depth)
 
+    def set_queue_rows(self, rows: int) -> None:
+        self._g_queue_rows.set(rows)
+
+    def record_shed(self) -> None:
+        self._c_shed.inc()
+
+    def record_timeout(self) -> None:
+        self._c_timeouts.inc()
+
+    def record_rollback(self) -> None:
+        self._c_rollbacks.inc()
+
     def mark_warmup_done(self) -> None:
         """Anchor the recompile counter: compiles past this point are
         recompiles (the serve_smoke.py zero-recompile assertion)."""
@@ -227,9 +274,13 @@ class ServingMetrics:
                 "batches": self.batches,
                 "rows_per_batch": round(rows_per_batch, 2),
                 "queue_depth": self.queue_depth,
+                "queue_rows": self.queue_rows,
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,
                 "errors": self.errors,
+                "shed": self.shed,
+                "request_timeouts": self.request_timeouts,
+                "rollbacks": self.rollbacks,
                 "backend_compiles": backend_compile_count(),
                 "recompiles_after_warmup":
                     backend_compile_count() - self._compile_floor,

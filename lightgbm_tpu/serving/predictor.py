@@ -34,9 +34,10 @@ import numpy as np
 
 from ..bucketing import pow2_bucket, pow2_ladder
 from ..core import tree as tree_mod
-from ..log import LightGBMError, check
+from ..log import LightGBMError, Log, check
 from ..parallel.mesh import replicated, row_sharding, serving_mesh
 from ..config import SERVING_BACKENDS
+from ..resilience import faults
 from . import traversal as traversal_mod
 from .metrics import ServingMetrics
 from .registry import ModelBundle, ModelRegistry
@@ -131,7 +132,9 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  backend: str = "traversal", cascade_trees: int = 0,
                  cascade_margin: float = 10.0,
-                 quantize_leaves: bool = False):
+                 quantize_leaves: bool = False,
+                 guard_hot_roll: bool = True, canary_rows: int = 16,
+                 roll_max_latency_ms: float = 0.0):
         check(max_batch >= 1 and min_bucket >= 1,
               "serve_max_batch and serve_min_bucket must be >= 1")
         check(backend in SERVING_BACKENDS,
@@ -147,6 +150,9 @@ class ServingEngine:
         self.cascade_trees = int(cascade_trees)
         self.cascade_margin = float(cascade_margin)
         self.quantize_leaves = bool(quantize_leaves)
+        self.guard_hot_roll = bool(guard_hot_roll)
+        self.canary_rows = max(int(canary_rows), 1)
+        self.roll_max_latency_ms = max(float(roll_max_latency_ms), 0.0)
         self.registry = registry if registry is not None else ModelRegistry()
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.mesh = serving_mesh(num_devices) if num_devices != 1 else None
@@ -202,6 +208,9 @@ class ServingEngine:
         accounts its callers itself (per-caller count + queue-inclusive
         latency) so a fused dispatch is not double-counted."""
         t0 = time.perf_counter()
+        # serve_predict seam: "request" = dispatched predict, counted by
+        # the plan's per-point counter (fused queue batches count once)
+        faults.inject("serve_predict", model=model_id)
         bundle = self.registry.get(model_id)
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
@@ -317,12 +326,105 @@ class ServingEngine:
         compilation in the window — the staged bundle's device stacking
         included, not just the predictor compiles — to the warmup
         floors. Caller commits with ``registry.register(bundle,
-        replace=True)`` (CheckpointWatcher.poll does exactly this)."""
+        replace=True)`` (CheckpointWatcher.poll does exactly this).
+
+        Guarded roll (``guard_hot_roll``, docs/Resilience.md): canary
+        rows are scored on the staged bundle — finite outputs,
+        traversal-vs-replay parity, optional latency cap — and a failing
+        bundle is REFUSED: its compiled entries are purged, the
+        ``lgbm_serving_rollbacks_total`` counter ticks, and the raised
+        LightGBMError leaves the prior generation serving untouched."""
         from ..profiling import backend_compile_count
         c0 = backend_compile_count()
         m0 = self.metrics.cache_misses
-        bundle = self.registry.stage_file(model_id, path)
-        self._warm_bundle(bundle, raw_scores, num_iterations)
-        self.metrics.add_warmup_credit(backend_compile_count() - c0,
-                                       self.metrics.cache_misses - m0)
+        try:
+            bundle = self.registry.stage_file(model_id, path)
+            self._warm_bundle(bundle, raw_scores, num_iterations)
+            if self.guard_hot_roll:
+                try:
+                    self._validate_bundle(bundle)
+                except LightGBMError as e:
+                    self.metrics.record_rollback()
+                    self._purge_generation(model_id,
+                                           getattr(bundle, "generation", 0))
+                    Log.warning("hot-roll REFUSED for %r (%s): prior "
+                                "generation stays live", model_id, e)
+                    raise
+        finally:
+            # validation compiles (if any) are staged-roll work, never
+            # serving recompiles — credit even on refusal
+            self.metrics.add_warmup_credit(backend_compile_count() - c0,
+                                           self.metrics.cache_misses - m0)
         return bundle
+
+    # ------------------------------------------------------------ guard
+    def _purge_generation(self, model_id: str, generation: int) -> None:
+        """Drop every compiled entry of one (model, generation) — the
+        refused staged bundle's predictors must not linger in device
+        memory or ever serve a request."""
+        with self._lock:
+            for key in [k for k in self._cache
+                        if k[0] == model_id and k[1] == generation]:
+                del self._cache[key]
+
+    def _canary(self, bundle: ModelBundle) -> np.ndarray:
+        """Deterministic canary rows: a fixed grid spanning a wide value
+        range (zeros, extremes, and a dense ramp), enough to route down
+        both sides of any split and surface NaN/inf leaves."""
+        nf = max(bundle.num_features, 1)
+        n = self.canary_rows
+        X = np.linspace(-1e3, 1e3, num=n * nf,
+                        dtype=np.float32).reshape(n, nf)
+        X[0, :] = 0.0
+        if n > 1:
+            X[1, :] = np.float32(1e30)
+        return X
+
+    def _validate_bundle(self, bundle: ModelBundle) -> None:
+        """Score canary rows on the STAGED bundle; raise LightGBMError on
+        any failed check. Runs inside the stage_and_prewarm credit window
+        so nothing here counts as a serving recompile."""
+        X = self._canary(bundle)
+        iters = bundle.effective_iterations(None)
+        b = bucket_rows(X.shape[0], self.min_bucket, self.max_batch)
+        xpad = X
+        if b != X.shape[0]:
+            xpad = np.zeros((b, X.shape[1]), np.float32)
+            xpad[:X.shape[0]] = X
+        entry = self._predictor(bundle, b, False, iters)
+        # lgbm-lint: disable=LGL103 canary probe, sync is the point
+        jax.block_until_ready(entry(xpad))   # warm before timing
+        t1 = time.perf_counter()
+        # lgbm-lint: disable=LGL103 canary latency measurement
+        out = np.asarray(jax.block_until_ready(entry(xpad)))[:X.shape[0]]
+        latency_ms = (time.perf_counter() - t1) * 1000.0
+        if not np.isfinite(out).all():
+            bad = int(np.count_nonzero(~np.isfinite(out)))
+            raise LightGBMError(
+                "staged model %r failed canary validation: %d non-finite "
+                "output(s) across %d canary rows"
+                % (bundle.model_id, bad, X.shape[0]))
+        if bundle.host_models is not None:
+            # eager traversal-vs-replay parity on the canary rows: both
+            # paths must agree before the flat forest serves traffic
+            flat, depth = bundle.flat_for(iters)
+            trees = bundle.trees_for(iters)
+            xj = jnp.asarray(X)
+            a = np.asarray(traversal_mod.forest_scores_flat(
+                flat, xj, bundle.num_tree_per_iteration, depth))
+            r = np.asarray(tree_mod.predict_forest_scores(trees, xj))
+            if not (np.isfinite(a).all() and np.isfinite(r).all()):
+                raise LightGBMError(
+                    "staged model %r failed canary validation: non-finite "
+                    "raw scores (traversal/replay)" % bundle.model_id)
+            if not np.allclose(a, r, rtol=1e-5, atol=1e-5):
+                raise LightGBMError(
+                    "staged model %r failed canary validation: traversal "
+                    "vs replay diverge (max |diff| %.3g)"
+                    % (bundle.model_id, float(np.max(np.abs(a - r)))))
+        if self.roll_max_latency_ms and \
+                latency_ms > self.roll_max_latency_ms:
+            raise LightGBMError(
+                "staged model %r failed canary validation: warmed predict "
+                "took %.1f ms > serve_roll_max_latency_ms=%.1f"
+                % (bundle.model_id, latency_ms, self.roll_max_latency_ms))

@@ -256,6 +256,7 @@ class CheckpointWatcher:
         self.poll_interval = float(poll_interval)
         self.engine = engine
         self._last_id = -1
+        self._rejected_ids: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -264,17 +265,31 @@ class CheckpointWatcher:
         than what we already rolled in. Returns True when a (re)load
         happened; verification failures fall back exactly like resume
         does (manifest checksums, newest -> oldest). With an attached
-        engine the staged bundle is prewarmed BEFORE the swap."""
+        engine the staged bundle is prewarmed BEFORE the swap — and a
+        bundle the engine's guarded roll REFUSES (canary validation,
+        docs/Resilience.md) is remembered and skipped on later polls, the
+        prior generation left serving."""
         from ..checkpoint.manager import CheckpointManager
         from ..log import Log
         latest = CheckpointManager(self.checkpoint_dir).latest_model()
         if latest is None:
             return False
         snap_id, model_path = latest
-        if snap_id <= self._last_id:
+        if snap_id <= self._last_id or snap_id in self._rejected_ids:
             return False
         if self.engine is not None:
-            bundle = self.engine.stage_and_prewarm(self.model_id, model_path)
+            from ..log import LightGBMError
+            try:
+                bundle = self.engine.stage_and_prewarm(self.model_id,
+                                                       model_path)
+            except LightGBMError as e:
+                self._rejected_ids.add(snap_id)
+                live = (self.model_id in self.registry.ids())
+                Log.warning("serving: snapshot %d REJECTED for model %r "
+                            "(%s); %s", snap_id, self.model_id, e,
+                            "prior generation stays live" if live
+                            else "no prior generation registered")
+                return False
         else:
             bundle = self.registry.stage_file(self.model_id, model_path)
         self.registry.register(bundle, replace=True)

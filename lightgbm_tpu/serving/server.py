@@ -31,8 +31,9 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..config import Config
-from ..log import Log, LightGBMError
+from ..log import Log, LightGBMError, OverloadedError
 from ..obs.registry import get_registry
+from ..resilience.breaker import CircuitBreaker
 from .batching import MicroBatchQueue
 from .metrics import ServingMetrics
 from .predictor import ServingEngine, bucket_sizes
@@ -45,12 +46,21 @@ def _predictions_payload(model_id: str, out: np.ndarray) -> Dict:
 
 
 class ServingApp:
-    """Engine + queue + registry bound together for a transport to drive."""
+    """Engine + queue + registry bound together for a transport to drive.
+
+    The circuit breaker sits BETWEEN validation and dispatch: client
+    errors (missing data, unknown model, bad width) are classified before
+    the queue and never count as failures; only dispatch failures — the
+    engine itself is sick — advance the breaker. An open breaker rejects
+    fast with OverloadedError carrying the Retry-After hint; transports
+    map that to 503."""
 
     def __init__(self, engine: ServingEngine,
-                 queue: Optional[MicroBatchQueue] = None):
+                 queue: Optional[MicroBatchQueue] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.engine = engine
         self.queue = queue if queue is not None else MicroBatchQueue(engine)
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.queue.start()
 
     # ------------------------------------------------------------ requests
@@ -65,10 +75,28 @@ class ServingApp:
         data = req.get("data")
         if data is None:
             raise LightGBMError('request is missing "data"')
+        # client-side validation BEFORE the breaker/queue: an unknown
+        # model or wrong width is the caller's fault, not engine sickness
+        self.engine.registry.get(model_id)
         X = np.asarray(data, np.float32)
-        out = self.queue.predict(
-            model_id, X, raw_score=bool(req.get("raw_score", False)),
-            num_iteration=req.get("num_iteration"))
+        if not self.breaker.allow():
+            self.engine.metrics.record_shed()
+            raise OverloadedError(
+                "circuit breaker open (%d consecutive dispatch failures); "
+                "retry in %.1fs"
+                % (self.breaker.failure_threshold,
+                   self.breaker.retry_after_s()),
+                retry_after_s=max(self.breaker.retry_after_s(), 0.1))
+        try:
+            out = self.queue.predict(
+                model_id, X, raw_score=bool(req.get("raw_score", False)),
+                num_iteration=req.get("num_iteration"))
+        except OverloadedError:
+            raise          # admission shed: not an engine failure
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
         return _predictions_payload(model_id, out)
 
     def handle_models(self) -> Dict:
@@ -90,21 +118,33 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through our logger, not stderr
         Log.debug("serve: " + fmt, *args)
 
-    def _reply(self, code: int, payload: Dict) -> None:
+    def _reply(self, code: int, payload: Dict,
+               retry_after_s: Optional[float] = None) -> None:
         self._reply_raw(code, json.dumps(payload).encode("utf-8"),
-                        "application/json")
+                        "application/json", retry_after_s=retry_after_s)
 
-    def _reply_raw(self, code: int, body: bytes, ctype: str) -> None:
+    def _reply_raw(self, code: int, body: bytes, ctype: str,
+                   retry_after_s: Optional[float] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if retry_after_s is not None:
+            self.send_header("Retry-After",
+                             str(max(int(round(retry_after_s)), 1)))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 - http.server API
         if self.path == "/healthz":
-            self._reply(200, {"status": "ok",
-                              "models": self.app.engine.registry.ids()})
+            brk = self.app.breaker.snapshot()
+            code = 200 if brk["state"] != "open" else 503
+            self._reply(code, {"status": "ok" if code == 200 else "degraded",
+                               "models": self.app.engine.registry.ids(),
+                               "breaker": brk})
+        elif self.path == "/stats":
+            snap = self.app.engine.metrics.snapshot()
+            snap["breaker"] = self.app.breaker.snapshot()
+            self._reply(200, snap)
         elif self.path == "/metrics":
             self._reply(200, self.app.engine.metrics.snapshot())
         elif self.path == "/metrics/prometheus":
@@ -125,6 +165,11 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             req = json.loads(self.rfile.read(length) or b"{}")
             self._reply(200, self.app.handle_predict(req))
+        except OverloadedError as e:
+            # shed (bounded admission) or breaker-open: 503 + Retry-After
+            self._reply(503, {"error": str(e),
+                              "retry_after_s": e.retry_after_s},
+                        retry_after_s=e.retry_after_s)
         except (LightGBMError, ValueError, KeyError) as e:
             self.app.engine.metrics.record_error()
             self._reply(400, {"error": str(e)})
@@ -151,6 +196,9 @@ def serve_stdin(app: ServingApp, in_stream=None, out_stream=None) -> int:
             break
         try:
             reply = app.handle_predict(json.loads(line))
+        except OverloadedError as e:
+            reply = {"error": str(e), "overloaded": True,
+                     "retry_after_s": e.retry_after_s}
         except (LightGBMError, ValueError, KeyError) as e:
             app.engine.metrics.record_error()
             reply = {"error": str(e)}
@@ -173,17 +221,29 @@ def _metrics_writer(metrics: ServingMetrics, path: str, freq_s: float,
 def build_app(config: Config) -> ServingApp:
     """Engine + queue from serve_* config; loads ``input_model`` (if any)
     under id "default" — tests/embedders register models themselves."""
+    if config.fault_inject:
+        from ..resilience import faults
+        faults.install_plan(config.fault_inject, config.fault_seed)
     engine = ServingEngine(
         max_batch=config.serve_max_batch, min_bucket=config.serve_min_bucket,
         num_devices=config.serve_num_devices,
         backend=config.serving_backend,
         cascade_trees=config.serving_cascade_trees,
         cascade_margin=config.serving_cascade_margin,
-        quantize_leaves=config.serving_quantize_leaves)
+        quantize_leaves=config.serving_quantize_leaves,
+        guard_hot_roll=config.serve_guard_hot_roll,
+        canary_rows=config.serve_canary_rows,
+        roll_max_latency_ms=config.serve_roll_max_latency_ms)
     if config.input_model:
         engine.registry.load_file("default", config.input_model)
-    app = ServingApp(engine, MicroBatchQueue(
-        engine, deadline_ms=config.serve_deadline_ms))
+    app = ServingApp(
+        engine,
+        MicroBatchQueue(engine, deadline_ms=config.serve_deadline_ms,
+                        max_queue_rows=config.serve_max_queue_rows,
+                        request_timeout_ms=config.serve_request_timeout_ms),
+        breaker=CircuitBreaker(
+            failure_threshold=config.serve_breaker_failures,
+            cooldown_s=config.serve_breaker_cooldown_s))
     return app
 
 

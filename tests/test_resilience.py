@@ -1,0 +1,580 @@
+"""lightgbm_tpu.resilience — fault injection, supervision, overload guard.
+
+Contracts pinned here (docs/Resilience.md):
+- fault plans parse deterministically; unknown kinds fail at config time;
+  single-shot faults fire exactly once; with no plan installed inject()
+  is inert;
+- KvHostComm surfaces timeouts as LightGBMError naming namespace / round
+  / rank / key / elapsed ms, retries transient set/get failures with
+  backoff, and fails FAST on a dead peer via the heartbeat guard;
+- LoopbackComm: a crashing simulated rank breaks the barrier and peers
+  get a clean LightGBMError instead of hanging forever;
+- MicroBatchQueue: row-bounded admission sheds with OverloadedError,
+  queue depth is reported in both requests and rows, submit during drain
+  is a clean error (not a hang), drained requests still get answers;
+- Watchdog: warmup-aware first deadline (slow-but-alive first compile
+  never false-fires), fires once heartbeats stop;
+- Supervisor: bounded restarts with exponential backoff, resumes from the
+  checkpoint dir, exhaustion raises with the LAST flight-dump path;
+- CircuitBreaker: trips after N consecutive failures, admits exactly one
+  half-open probe after the cooldown, probe failure re-opens;
+- guarded hot-roll: a staged NaN model is refused (rollbacks counter,
+  prior generation keeps serving);
+- supervised training with an injected crash auto-resumes and the final
+  model is byte-identical to the uninterrupted run.
+"""
+import os
+import re
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+from lightgbm_tpu.log import LightGBMError, OverloadedError
+from lightgbm_tpu.parallel.network import KvHostComm, LoopbackComm
+from lightgbm_tpu.resilience import breaker as breaker_mod
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.resilience.breaker import CircuitBreaker
+from lightgbm_tpu.resilience.supervisor import (ATTEMPT_ENV, KvHeartbeat,
+                                                ProcessSupervisor, Supervisor,
+                                                Watchdog,
+                                                heartbeat_file_callback)
+from lightgbm_tpu.serving import ServingEngine
+from lightgbm_tpu.serving.batching import MicroBatchQueue
+from lightgbm_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+# ------------------------------------------------------------- fault plans
+def test_fault_plan_parses_units_and_args():
+    plan = faults.parse_plan(
+        "kv_timeout@block:2,kill@iter:7,serve_error@req:50,"
+        "serve_delay@request:*:125,hang@iteration:3:10")
+    specs = {repr(f) for f in plan.faults}
+    assert "kv_timeout@round:2" in specs           # block -> round alias
+    assert "kill@iteration:7" in specs             # iter -> iteration
+    assert "serve_error@request:50" in specs
+    assert "serve_delay@request:*:125" in specs
+    d = [f for f in plan.faults if f.kind == "serve_delay"][0]
+    assert d.match is None and d.arg_float(0.0) == 125.0
+    h = [f for f in plan.faults if f.kind == "hang"][0]
+    assert h.arg_float(3600.0) == 10.0
+
+
+@pytest.mark.parametrize("bad", ["bogus@iter:1", "kill", "kill@iter:x",
+                                 "kill@:3"])
+def test_fault_plan_rejects_malformed(bad):
+    with pytest.raises(LightGBMError):
+        faults.parse_plan(bad)
+
+
+def test_inject_inert_without_plan_and_single_shot():
+    # no plan installed: inject is a no-op at any point
+    faults.inject("serve_predict")
+    faults.inject("train_dispatch", iteration=7)
+
+    faults.install_plan("serve_error@req:2")
+    faults.inject("serve_predict")                 # req 1: no fire
+    with pytest.raises(LightGBMError, match="injected serving fault"):
+        faults.inject("serve_predict")             # req 2: fires
+    faults.inject("serve_predict")                 # single shot: spent
+    # identical re-install keeps the plan (fire counts survive restarts)
+    plan = faults.active_plan()
+    assert faults.install_plan("serve_error@req:2") is plan
+    faults.inject("serve_predict")
+
+
+def test_config_validates_fault_plan():
+    from lightgbm_tpu.config import Config
+    c = Config({"fault_inject": "crash@iter:3", "fault_seed": 5})
+    assert c.fault_inject == "crash@iter:3" and c.fault_seed == 5
+    with pytest.raises(LightGBMError):
+        Config({"fault_inject": "nope@iter:1"})
+
+
+# ---------------------------------------------------------------- KV comm
+class StubKv:
+    """Dict-backed coordination-service client double."""
+
+    def __init__(self, fail_sets=0, fail_gets=0):
+        self.store = {}
+        self.fail_sets = fail_sets
+        self.fail_gets = fail_gets
+        self.set_calls = 0
+
+    def key_value_set(self, key, value):
+        self.set_calls += 1
+        if self.fail_sets > 0:
+            self.fail_sets -= 1
+            raise RuntimeError("UNAVAILABLE: stub transient set failure")
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if self.fail_gets > 0:
+            self.fail_gets -= 1
+            raise RuntimeError("UNAVAILABLE: stub transient get failure")
+        if key in self.store:
+            return self.store[key]
+        time.sleep(min(timeout_ms / 1000.0, 0.01))
+        raise RuntimeError("DEADLINE_EXCEEDED: stub timeout")
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+
+def _comm(stub, rank=0, n=2, timeout_ms=250, **kw):
+    return KvHostComm(namespace="t_res", timeout_ms=timeout_ms, client=stub,
+                      num_processes=n, rank=rank, retry_backoff_s=0.01, **kw)
+
+
+def _publish_peer(stub, r, rank, obj):
+    import base64
+    import pickle
+    stub.store["t_res/r%d/p%d" % (r, rank)] = base64.b64encode(
+        pickle.dumps(obj)).decode("ascii")
+
+
+def test_kv_allgather_roundtrip_and_set_retry():
+    stub = StubKv(fail_sets=2)
+    comm = _comm(stub)
+    _publish_peer(stub, 0, 1, {"peer": 1})
+    out = comm.allgather({"peer": 0})
+    assert out == [{"peer": 0}, {"peer": 1}]
+    assert stub.set_calls == 3                      # 2 transient + 1 ok
+
+
+def test_kv_timeout_surfaces_context():
+    stub = StubKv()
+    comm = _comm(stub, timeout_ms=150)
+    with pytest.raises(LightGBMError) as ei:       # peer 1 never publishes
+        comm.allgather("x")
+    msg = str(ei.value)
+    for needle in ("t_res", "round=0", "rank=0", "peer=1",
+                   "t_res/r0/p1", "elapsed"):
+        assert needle in msg, msg
+
+
+def test_kv_set_retry_budget_exhausted():
+    stub = StubKv(fail_sets=10)
+    comm = _comm(stub, retries=2)
+    with pytest.raises(LightGBMError, match="after 3 attempt"):
+        comm.allgather("x")
+
+
+def test_kv_peer_guard_fails_fast():
+    stub = StubKv()
+    comm = _comm(stub, timeout_ms=60000, peer_guard=lambda: [1])
+    t0 = time.monotonic()
+    with pytest.raises(LightGBMError, match="peer rank 1 is DEAD"):
+        comm.allgather("x")
+    assert time.monotonic() - t0 < 10.0            # not the 60s timeout
+
+
+def test_kv_injected_transient_error_retried():
+    faults.install_plan("kv_error@calls:1")
+    stub = StubKv()
+    comm = _comm(stub)
+    _publish_peer(stub, 0, 1, "b")
+    assert comm.allgather("a") == ["a", "b"]       # retried through the fault
+
+
+# ------------------------------------------------------------ LoopbackComm
+def test_loopback_crashing_rank_does_not_hang_peers():
+    comms = LoopbackComm.group(3, timeout_s=20.0)
+    results = {}
+
+    def good(rank):
+        try:
+            results[rank] = comms[rank].allgather(rank)
+        except LightGBMError as e:
+            results[rank] = e
+
+    def bad(rank):
+        try:
+            comms[rank]._shared["slots"][rank] = rank
+            raise RuntimeError("simulated rank death")
+        except RuntimeError:
+            comms[rank].abort()
+
+    threads = [threading.Thread(target=good, args=(r,)) for r in (0, 1)]
+    threads.append(threading.Thread(target=bad, args=(2,)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "peer thread hung on broken barrier"
+    for r in (0, 1):
+        assert isinstance(results[r], LightGBMError)
+        assert "rank 2 crashed" in str(results[r])
+
+
+def test_loopback_normal_allgather_still_works():
+    comms = LoopbackComm.group(2)
+    out = {}
+    ts = [threading.Thread(target=lambda r=r: out.setdefault(
+        r, comms[r].allgather(r * 10))) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert out[0] == [0, 10] and out[1] == [0, 10]
+
+
+# -------------------------------------------------------------- micro queue
+class FakeEngine:
+    """Just enough of ServingEngine for queue-only tests."""
+
+    def __init__(self, predict_s=0.0, max_batch=1024):
+        self.metrics = ServingMetrics()
+        self.max_batch = max_batch
+        self.predict_s = predict_s
+
+    def predict(self, model_id, X, raw_score=False, num_iteration=None,
+                _record_request=True):
+        if self.predict_s:
+            time.sleep(self.predict_s)
+        return np.zeros((X.shape[0],), np.float64)
+
+
+def test_queue_reports_rows_and_requests():
+    eng = FakeEngine()
+    q = MicroBatchQueue(eng, deadline_ms=500.0).start()
+    try:
+        q.submit("m", np.zeros((3, 2), np.float32))
+        q.submit("m", np.zeros((5, 2), np.float32))
+        assert eng.metrics.queue_depth == 2        # requests
+        assert eng.metrics.queue_rows == 8         # rows
+        snap = eng.metrics.snapshot()
+        assert snap["queue_depth"] == 2 and snap["queue_rows"] == 8
+    finally:
+        q.stop(drain=False)
+
+
+def test_queue_sheds_past_row_bound():
+    eng = FakeEngine()
+    q = MicroBatchQueue(eng, deadline_ms=500.0, max_queue_rows=4).start()
+    try:
+        q.submit("m", np.zeros((3, 2), np.float32))
+        with pytest.raises(OverloadedError) as ei:
+            q.submit("m", np.zeros((3, 2), np.float32))
+        assert ei.value.retry_after_s > 0
+        assert eng.metrics.shed == 1
+        assert eng.metrics.queue_rows <= 4
+    finally:
+        q.stop(drain=False)
+
+
+def test_queue_submit_during_drain_clean_error():
+    eng = FakeEngine(predict_s=0.3)
+    q = MicroBatchQueue(eng, deadline_ms=0.0).start()
+    f1 = q.submit("m1", np.zeros((2, 2), np.float32))
+    time.sleep(0.1)                                # worker is dispatching f1
+    f2 = q.submit("m2", np.zeros((1, 2), np.float32))  # queued behind it
+    stopper = threading.Thread(target=q.stop)      # drain=True
+    stopper.start()
+    time.sleep(0.05)
+    with pytest.raises(LightGBMError, match="draining"):
+        q.submit("m3", np.zeros((1, 2), np.float32))
+    stopper.join(timeout=10)
+    assert not stopper.is_alive()
+    assert f1.result(timeout=5).shape == (2,)      # drained, not dropped
+    assert f2.result(timeout=5).shape == (1,)
+
+
+def test_queue_request_timeout_expires_stale_requests():
+    eng = FakeEngine(predict_s=0.25)
+    q = MicroBatchQueue(eng, deadline_ms=0.0,
+                        request_timeout_ms=100.0).start()
+    try:
+        # first request occupies the worker; the second exceeds its
+        # deadline while queued and is expired at dispatch
+        f1 = q.submit("m", np.zeros((1, 2), np.float32))
+        time.sleep(0.05)
+        f2 = q.submit("m", np.zeros((1, 2), np.float32))
+        assert f1.result(timeout=5).shape == (1,)
+        with pytest.raises(OverloadedError, match="expired in queue"):
+            f2.result(timeout=5)
+        assert eng.metrics.request_timeouts == 1
+    finally:
+        q.stop(drain=False)
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_warmup_grace_no_false_fire():
+    fired = []
+    wd = Watchdog(0.15, warmup_grace_s=1.5, on_fire=fired.append).start()
+    try:
+        time.sleep(0.5)          # slow-but-alive first compile window
+        assert not wd.fired and not fired
+        wd.beat()
+        time.sleep(0.05)
+        assert not wd.fired
+    finally:
+        wd.stop()
+        faults.clear_abort()
+
+
+def test_watchdog_fires_when_beats_stop():
+    fired = []
+    wd = Watchdog(0.1, warmup_grace_s=0.0, on_fire=fired.append).start()
+    try:
+        wd.beat()
+        time.sleep(0.5)
+        assert wd.fired and len(fired) == 1
+        assert faults.abort_event().is_set()
+        with pytest.raises(faults.WatchdogAbort):
+            faults.inject("train_dispatch", iteration=0)
+    finally:
+        wd.stop()
+        faults.clear_abort()
+
+
+def test_heartbeat_file_callback_touches(tmp_path):
+    path = str(tmp_path / "hb")
+    cb = heartbeat_file_callback(path)
+    assert cb.before_iteration
+    cb(SimpleNamespace(iteration=4))
+    assert os.path.exists(path)
+    assert open(path).read().startswith("4 ")
+
+
+# --------------------------------------------------------------- supervisor
+def test_supervisor_needs_checkpoint_dir():
+    with pytest.raises(LightGBMError, match="checkpoint_dir"):
+        Supervisor("")
+
+
+def test_supervisor_retries_then_succeeds(tmp_path):
+    sup = Supervisor(str(tmp_path), max_restarts=3, backoff_s=0.01,
+                     backoff_max_s=0.02)
+    seen = []
+
+    def attempt(resume, wd):
+        seen.append(resume)
+        if len(seen) < 3:
+            raise RuntimeError("boom %d" % len(seen))
+        return "done"
+
+    assert sup.run(attempt) == "done"
+    assert sup.restarts == 2
+    assert seen[0] is None                       # first try: fresh
+    assert seen[1] == str(tmp_path)              # retries resume
+
+
+def test_supervisor_exhaustion_names_flight_dump(tmp_path):
+    sup = Supervisor(str(tmp_path), max_restarts=2, backoff_s=0.01,
+                     backoff_max_s=0.02)
+
+    def attempt(resume, wd):
+        err = RuntimeError("persistent failure")
+        err.flight_dump_path = "/tmp/events.0.crash.jsonl"
+        raise err
+
+    with pytest.raises(LightGBMError) as ei:
+        sup.run(attempt)
+    msg = str(ei.value)
+    assert "after 2 restarts" in msg
+    assert "/tmp/events.0.crash.jsonl" in msg
+    assert sup.restarts == 3                     # initial + 2 restarts
+
+
+def test_process_supervisor_attempt_env(tmp_path):
+    import sys
+    prog = ("import os, sys; "
+            "sys.exit(0 if os.environ['%s'] == '1' else 7)" % ATTEMPT_ENV)
+    sup = ProcessSupervisor([sys.executable, "-c", prog], max_restarts=2,
+                            backoff_s=0.01, backoff_max_s=0.02)
+    assert sup.run() == 0
+    assert sup.restarts == 1 and sup.attempts == [7, 0]
+
+
+def test_process_supervisor_budget_exhaustion():
+    import sys
+    sup = ProcessSupervisor([sys.executable, "-c", "import sys; sys.exit(3)"],
+                            max_restarts=1, backoff_s=0.01,
+                            backoff_max_s=0.02)
+    with pytest.raises(LightGBMError, match="after 1 restarts"):
+        sup.run()
+
+
+def test_kv_heartbeat_leases():
+    stub = StubKv()
+    hb = KvHeartbeat(namespace="hb_t", period_s=0.1, lease_s=0.2,
+                     client=stub, rank=0, num_processes=2)
+    hb.start()
+    try:
+        assert "hb_t/p0" in stub.store
+        assert hb.dead_peers() == []             # startup grace
+        time.sleep(0.35)
+        assert hb.dead_peers() == [1]            # never seen past lease
+        stub.store["hb_t/p1"] = "%.6f" % time.time()
+        assert hb.dead_peers() == []
+        stub.store["hb_t/p1"] = "%.6f" % (time.time() - 5.0)
+        assert hb.dead_peers() == [1]            # stale lease
+    finally:
+        hb.stop()
+    assert "hb_t/p0" not in stub.store           # lease released on stop
+
+
+# ----------------------------------------------------------- circuit breaker
+def test_breaker_trip_halfopen_probe():
+    brk = CircuitBreaker(failure_threshold=2, cooldown_s=0.15)
+    assert brk.allow()
+    brk.record_failure()
+    assert brk.state == breaker_mod.CLOSED and brk.allow()
+    brk.record_failure()                          # second consecutive: trip
+    assert brk.state == breaker_mod.OPEN
+    assert not brk.allow() and brk.retry_after_s() > 0
+    time.sleep(0.2)
+    assert brk.allow()                            # the half-open probe
+    assert brk.state == breaker_mod.HALF_OPEN
+    assert not brk.allow()                        # only ONE probe in flight
+    brk.record_failure()                          # probe failed: re-open
+    assert brk.state == breaker_mod.OPEN and brk.trips == 2
+    time.sleep(0.2)
+    assert brk.allow()
+    brk.record_success()                          # probe ok: close + reset
+    assert brk.state == breaker_mod.CLOSED and brk.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    brk = CircuitBreaker(failure_threshold=3, cooldown_s=1.0)
+    for _ in range(2):
+        brk.record_failure()
+    brk.record_success()
+    for _ in range(2):
+        brk.record_failure()
+    assert brk.state == breaker_mod.CLOSED       # never 3 consecutive
+    assert CircuitBreaker(failure_threshold=0).allow()   # 0 disables
+
+
+# --------------------------------------------------------- guarded hot-roll
+def _tiny_model(tmp_path):
+    r = np.random.RandomState(3)
+    X = r.randn(160, 4)
+    y = X[:, 0] * 2 + np.abs(X[:, 1]) + 0.1 * r.randn(160)
+    params = dict(objective="regression", num_leaves=4, min_data_in_leaf=5,
+                  verbosity=-1)
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    bst = engine.train(dict(params), ds, num_boost_round=3,
+                       verbose_eval=False)
+    path = str(tmp_path / "good.txt")
+    bst.save_model(path)
+    return path, X[:4]
+
+
+def _nan_copy(src, dst):
+    text = open(src).read()
+
+    def poison(m):
+        n = len(m.group(1).split())
+        return "leaf_value=" + " ".join(["nan"] * n)
+
+    open(dst, "w").write(re.sub(r"leaf_value=([^\n]+)", poison, text))
+
+
+def test_guarded_roll_rejects_nan_model(tmp_path):
+    good, Xq = _tiny_model(tmp_path)
+    bad = str(tmp_path / "bad.txt")
+    _nan_copy(good, bad)
+    eng = ServingEngine(max_batch=16, min_bucket=16)
+    bundle = eng.stage_and_prewarm("m", good)     # good roll passes guard
+    eng.registry.register(bundle, replace=True)
+    ref = eng.predict("m", Xq)
+    with pytest.raises(LightGBMError, match="canary"):
+        eng.stage_and_prewarm("m", bad)
+    assert eng.metrics.rollbacks == 1
+    out = eng.predict("m", Xq)                    # prior generation lives
+    np.testing.assert_array_equal(out, ref)
+    assert np.isfinite(out).all()
+
+
+def test_guarded_roll_watcher_keeps_serving(tmp_path):
+    good, Xq = _tiny_model(tmp_path)
+    eng = ServingEngine(max_batch=16, min_bucket=16)
+    bundle = eng.stage_and_prewarm("m", good)
+    eng.registry.register(bundle, replace=True)
+    bad = str(tmp_path / "bad.txt")
+    _nan_copy(good, bad)
+    watcher = eng.registry.watch_dir("m", str(tmp_path), engine=eng)
+    watcher._last_id = 0
+    # monkeypatch the manifest lookup: snapshot 1 -> the poisoned file
+    import lightgbm_tpu.checkpoint.manager as mgr_mod
+    orig = mgr_mod.CheckpointManager.latest_model
+    mgr_mod.CheckpointManager.latest_model = lambda self: (1, bad)
+    try:
+        assert watcher.poll() is False            # rejected, not rolled
+        assert 1 in watcher._rejected_ids
+        assert watcher.poll() is False            # remembered, no rework
+        assert eng.metrics.rollbacks == 1         # only validated once
+    finally:
+        mgr_mod.CheckpointManager.latest_model = orig
+    assert np.isfinite(eng.predict("m", Xq)).all()
+
+
+# ----------------------------------------------- supervised byte-identity
+def test_supervised_crash_resume_byte_identical(tmp_path):
+    r = np.random.RandomState(9)
+    X = r.randn(200, 5)
+    y = (X[:, 0] + 2 * X[:, 1] + 0.2 * r.randn(200) > 0).astype(np.float64)
+    base = dict(objective="binary", num_leaves=5, min_data_in_leaf=5,
+                verbosity=-1, checkpoint_period=1)
+
+    golden_p = dict(base, checkpoint_dir=str(tmp_path / "g"))
+    ds = lgb.Dataset(X, label=y, params=dict(golden_p))
+    golden = engine.train(dict(golden_p), ds, num_boost_round=6,
+                          verbose_eval=False)
+
+    victim_p = dict(base, checkpoint_dir=str(tmp_path / "v"),
+                    fault_inject="crash@iter:3", supervise=True,
+                    supervise_backoff_s=0.01, supervise_backoff_max_s=0.02)
+    ds2 = lgb.Dataset(X, label=y, params=dict(victim_p))
+    victim = engine.train(dict(victim_p), ds2, num_boost_round=6,
+                          verbose_eval=False)
+
+    # byte-identical trees; the parameters echo differs by construction
+    # (checkpoint_dir path, the fault/supervise params themselves)
+    def trees_only(s):
+        return s.split("\nparameters:", 1)[0]
+
+    assert trees_only(victim.model_to_string()) == \
+        trees_only(golden.model_to_string())
+
+
+def test_supervised_exhaustion_raises(tmp_path):
+    r = np.random.RandomState(9)
+    X = r.randn(120, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = dict(objective="binary", num_leaves=4, min_data_in_leaf=5,
+             verbosity=-1, checkpoint_dir=str(tmp_path / "c"),
+             checkpoint_period=1, fault_inject="crash@iter:*",
+             supervise=True, supervise_max_restarts=1,
+             supervise_backoff_s=0.01, supervise_backoff_max_s=0.02)
+    ds = lgb.Dataset(X, label=y, params=dict(p))
+    with pytest.raises(LightGBMError, match="after 1 restart"):
+        engine.train(dict(p), ds, num_boost_round=4, verbose_eval=False)
+
+
+# -------------------------------------------------------- torn checkpoint
+def test_ckpt_torn_fault_breaks_sha(tmp_path):
+    from lightgbm_tpu.checkpoint import snapshot as snap_mod
+    from lightgbm_tpu.checkpoint.manifest import sha256_file
+    faults.install_plan("ckpt_torn@snap:1")
+    entry = snap_mod.write_snapshot(
+        str(tmp_path), 1, {"iteration": 1, "num_trees": 0,
+                           "num_leaves": [], "num_leaves_actual": [],
+                           "shrinkage": []},
+        {"scores": np.zeros((8, 1), np.float32)}, "model-text")
+    state = os.path.join(str(tmp_path), entry["files"]["state"])
+    # the recorded sha is the PRE-TEAR one: verification must fail
+    assert sha256_file(state) != entry["sha256"][entry["files"]["state"]]
