@@ -264,6 +264,34 @@ def run_bench(backend_info: dict) -> dict:
         except Exception as e:  # noqa: BLE001 - diagnostics must not kill it
             obs_overhead = {"obs_error": repr(e)[:200]}
 
+    # obs_modelstats overhead (ISSUE 12 acceptance: <= 5% vs off).  The
+    # per-wave split-stat accumulator rides the frontier carry, so the
+    # cost is a scatter-add/scatter-max pair per wave plus one extra
+    # device->host transfer per materialized tree — measured the same
+    # way as the observability block above (same binned dataset, warmup
+    # window excluded, best of two).
+    if os.environ.get("BENCH_MODELSTATS", "1") != "0":
+        try:
+            cfg_ms = Config(dict(cfg_d, obs_modelstats=True))
+            b_ms = create_boosting(cfg_ms, ds,
+                                   create_objective(cfg_ms), [])
+            b_ms.train_many(iters)
+            jax.block_until_ready(b_ms.scores)
+            ms_windows = []
+            for _ in range(2):
+                t0 = time.time()
+                b_ms.train_many(iters)
+                jax.block_until_ready(b_ms.scores)
+                ms_windows.append(time.time() - t0)
+            dt_ms = min(ms_windows)
+            obs_overhead.update({
+                "train_%d_iters_modelstats" % iters: round(dt_ms, 3),
+                "modelstats_windows": [round(w, 3) for w in ms_windows],
+                "modelstats_overhead_frac": round((dt_ms - dt) / dt, 5),
+            })
+        except Exception as e:  # noqa: BLE001
+            obs_overhead["modelstats_error"] = repr(e)[:200]
+
     iters_per_sec = iters / dt
     higgs_equiv = iters_per_sec * (n / HIGGS_ROWS)
     vs_baseline = higgs_equiv / BASELINE_ITERS_PER_SEC

@@ -514,7 +514,12 @@ class GBDT:
             # the aux slot off, so it stays off there (iteration-level
             # grad/hess health still applies on every path)
             obs_health=(frontier_mode and not self._partition_on_mesh
-                        and self.obs.health_enabled))
+                        and self.obs.health_enabled),
+            # model statistics ride the same aux slot under the same
+            # guard; the shard_map learners slice aux off, so they fall
+            # back to host-side recomputation at materialize
+            obs_modelstats=(frontier_mode and not self._partition_on_mesh
+                            and bool(cfg.obs_modelstats)))
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -547,6 +552,17 @@ class GBDT:
         self._last_block_len = 0
         self._last_flush_shapes: List[Any] = []
         self._valid_pred_cache: Dict[int, jnp.ndarray] = {}
+        # model statistics (obs.modelstats): host-side cumulative state,
+        # fed from the frontier piggy-back when grow_params carries it
+        # and recomputed from materialized trees otherwise
+        self._modelstats = None
+        if cfg.obs_modelstats:
+            from ..obs.modelstats import ModelStats
+            self._modelstats = ModelStats(
+                ds.num_total_features, feature_names=ds.feature_names,
+                inner_to_real=[ds.real_feature_index(i)
+                               for i in range(ds.num_features)],
+                registry=self.obs.registry, events=self.obs.events)
 
     def add_valid_data(self, ds: BinnedDataset, metrics: List[Metric]) -> None:
         for m in metrics:
@@ -1006,10 +1022,16 @@ class GBDT:
                     lambda gh: grow_one(gh[0], gh[1], cegb_state),
                     (g.T, h.T))
             # the grower's third output is CEGB state on the exact path
-            # and the [K, 2] health accumulator on the frontier path with
-            # obs_health (the two are config-exclusive)
+            # and, on the frontier path, the obs aux: the [K, 2] health
+            # accumulator with obs_health, or the (health_or_None,
+            # [K, F, MS_WIDTH] mstats) tuple with obs_modelstats (the
+            # frontier and CEGB paths are config-exclusive)
             grower_health = None
-            if params.frontier_mode and params.obs_health:
+            grower_mstats = None
+            if params.frontier_mode and params.obs_modelstats:
+                aux, cegb_out = cegb_out, None
+                grower_health, grower_mstats = aux
+            elif params.frontier_mode and params.obs_health:
                 grower_health, cegb_out = cegb_out, None
             if cegb_state is not None:
                 # classes train from the iteration-start state; acquisitions
@@ -1063,8 +1085,11 @@ class GBDT:
                 health = health_vec(g, h, any_split, grower_health)
             else:
                 health = jnp.zeros((4,), jnp.float32)
+            # grower_mstats is None unless obs_modelstats: a None output
+            # is an empty pytree leaf, so the compiled program (and every
+            # jaxpr fingerprint) is unchanged when the feature is off
             return pack_trees(trees), leaf_ids, new_scores, cegb_new, \
-                stopped_out, health
+                stopped_out, health, grower_mstats
 
         self._iter_core = run_iter   # unjitted: train_many scans over it
         return jax.jit(run_iter)
@@ -1116,19 +1141,22 @@ class GBDT:
                         .astype(jnp.float32)
                     bag_mask = jnp.where(refresh, new_mask, bag_mask)
                 sm = bag_mask if row_valid is None else bag_mask * row_valid
-                packed, _leaf_ids, sc2, cegb2, stopped2, health = core(
+                packed, _leaf_ids, sc2, cegb2, stopped2, health, ms = core(
                     xb, obj_rows, fp_capture, sc, sm, fm, g0, h0, lr, ga,
                     gkey, cegb, stopped)
-                return (sc2, bag_mask, cegb2, stopped2), (packed, health)
+                return (sc2, bag_mask, cegb2, stopped2), (packed, health, ms)
 
-            carry, (packs, healths) = lax.scan(
+            carry, (packs, healths, mstats) = lax.scan(
                 step, (scores, bag_mask0, cegb_state, stopped_in),
                 (feature_masks, goss_actives, iter_idxs, keys))
             new_scores, bag_mask, cegb_out, stopped_out = carry
             # healths: [block, 4] per-iteration health vectors (zeros when
-            # monitoring is off) — one tiny transfer per block, not per iter
+            # monitoring is off) — one tiny transfer per block, not per
+            # iter. mstats: [block, K, F, MS_WIDTH] per-iteration model
+            # statistics with obs_modelstats, else None (invisible in the
+            # compiled program)
             return packs, healths, new_scores, bag_mask, cegb_out, \
-                stopped_out
+                stopped_out, mstats
 
         return run_block
 
@@ -1360,7 +1388,7 @@ class GBDT:
             with obs.span("train_block", start_iter=self.iter_,
                           count=block):
                 packs, healths, self.scores, self._bag_mask, \
-                    self._cegb_state, self._stopped_dev = fn(
+                    self._cegb_state, self._stopped_dev, mstats = fn(
                         *self._iter_capture,
                         self.scores, fmasks, gactive, idxs, all_keys[1:],
                         self._bag_mask, self._cegb_state, self._stopped_dev,
@@ -1376,7 +1404,8 @@ class GBDT:
             t_done = time.perf_counter() if obs.enabled else 0.0
             self._pending.append({"packed": packs,
                                   "shrinkage": self.shrinkage_rate,
-                                  "count": block})
+                                  "count": block,
+                                  "mstats": mstats})
             self.iter_ += block
             done += block
             if obs.enabled:
@@ -1443,6 +1472,17 @@ class GBDT:
         ff_meta, ff_keys = snap_mod.rng_state_split(self._rng)
         meta["ff_rng"] = ff_meta
         arrays["ff_rng_keys"] = ff_keys
+        # training data profile (obs.drift): rides the JSON meta into
+        # snapshot meta.json so serving can score drift against it.
+        # Absence is legal (pre-profile snapshots keep loading; drift
+        # surfaces report "no_profile"), so failures only warn.
+        if self.train_data is not None:
+            try:
+                meta["data_profile"] = \
+                    self.train_data.data_profile().to_json_dict()
+            except Exception as e:  # noqa: BLE001 - profile is best-effort
+                Log.warning("data profile capture failed (%s); snapshot "
+                            "will carry none", e)
         inits = getattr(self, "init_score_offsets", None)
         if inits is not None:
             arrays["init_score_offsets"] = np.asarray(inits)
@@ -1592,7 +1632,7 @@ class GBDT:
         t_disp = t0
         with obs.span("train_iter", iteration=iter_idx):
             packed, leaf_ids, new_scores, cegb_new, self._stopped_dev, \
-                health = self._compiled_iter(
+                health, mstats = self._compiled_iter(
                     *self._iter_capture,
                     self.scores, sample_mask, feature_mask, g_in, h_in,
                     jnp.float32(self.shrinkage_rate),
@@ -1610,7 +1650,9 @@ class GBDT:
 
         pend: Dict[str, Any] = {"packed": packed[None],  # [1, K, T] block
                                 "shrinkage": self.shrinkage_rate,
-                                "count": 1}
+                                "count": 1,
+                                "mstats": (mstats[None]
+                                           if mstats is not None else None)}
         self._pending.append(pend)
         self.iter_ += 1
         if obs.enabled:
@@ -1652,7 +1694,7 @@ class GBDT:
         for p in pend:
             if self._stopped:
                 break
-            for _ in range(p["count"]):
+            for bi in range(p["count"]):
                 host_trees = []
                 any_split = False
                 for c in range(k):
@@ -1682,6 +1724,21 @@ class GBDT:
                     self.iter_ = len(self._models) // max(k, 1)
                     break
                 self._store_host_trees(host_trees, p)
+                if self._modelstats is not None:
+                    # model statistics track the KEPT model list exactly:
+                    # stump/overshoot iterations broke out above, so this
+                    # runs once per stored iteration. ingest after the
+                    # store so leaf values are the final (shrunk,
+                    # bias-folded) model values. Device accumulators
+                    # transfer once per pending entry, lazily.
+                    dev_rows = None
+                    if p.get("mstats") is not None:
+                        if "mstats_host" not in p:
+                            p["mstats_host"] = np.asarray(p["mstats"])
+                        dev_rows = p["mstats_host"][bi]
+                    self._modelstats.ingest_iteration(
+                        host_trees, len(self._models) // max(k, 1) - 1,
+                        device_rows=dev_rows)
         return self._stopped
 
     def _store_host_trees(self, host_trees: List[HostTree],

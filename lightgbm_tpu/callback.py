@@ -100,6 +100,47 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
     return _RecordEvaluation(eval_result)
 
 
+class _ExportEvalMetrics:
+    """Publish each iteration's eval tuples as ``lgbm_eval_metric``
+    gauges — the registry series train-time scrapers (StatsServer
+    ``/metrics``, the PR 9 cluster federation) watch for loss curves.
+    ``only_consumes_evals`` keeps the engine free to fuse iteration
+    blocks on device when nothing is evaluated."""
+
+    before_iteration = False
+    order = 15
+    only_consumes_evals = True
+
+    def __init__(self, registry=None):
+        self._reg = registry
+        self._gauges: Dict[tuple, Any] = {}
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if not env.evaluation_result_list:
+            return
+        if self._reg is None:
+            from .obs.registry import get_registry
+            self._reg = get_registry()
+        for entry in env.evaluation_result_list:
+            data_name, metric_name, value = entry[0], entry[1], entry[2]
+            g = self._gauges.get((data_name, metric_name))
+            if g is None:
+                g = self._reg.gauge(
+                    "lgbm_eval_metric",
+                    "Latest evaluation metric value, per dataset and "
+                    "metric, updated every evaluated iteration.",
+                    {"dataset": str(data_name), "metric": str(metric_name)})
+                self._gauges[(data_name, metric_name)] = g
+            g.set(float(value))
+
+
+def export_eval_metrics(registry=None) -> Callable:
+    """Stream eval results into the process metrics registry as
+    ``lgbm_eval_metric{dataset=,metric=}`` gauges (attached automatically
+    by ``engine.train``; pass explicitly to ``cv`` or custom loops)."""
+    return _ExportEvalMetrics(registry)
+
+
 class _ResetParameter:
     before_iteration = True
     order = 10

@@ -261,6 +261,23 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # and dumped to <obs_event_file>.<process>.crash.jsonl on HealthMonitor
     # abort, SIGTERM, or unhandled exception; 0 = off
     ("obs_flight_recorder", int, 512, ["obs_flight_recorder_size"]),
+    # ---- model statistics & drift (obs/modelstats.py, obs/drift.py) ----
+    # per-feature split-count/gain accumulators + leaf distributions,
+    # streamed as lgbm_model_* metrics and model_iter events. On the
+    # frontier grower this piggy-backs an accumulator on the wave loop
+    # (zero extra collectives); off keeps the compiled training program
+    # byte-identical to an uninstrumented build.
+    ("obs_modelstats", bool, False, ["model_stats", "modelstats"]),
+    # train/serve drift detection (serving side; needs a model with a
+    # training data profile): warn-only HealthMonitor routing + on_drift
+    # refit hooks fire when any feature's PSI crosses this threshold
+    ("obs_drift_warn_psi", float, 0.25, ["drift_warn_psi"]),
+    # decay factor of the served score-distribution sketch (per row)
+    ("obs_drift_decay", float, 0.999, ["drift_decay"]),
+    # rows observed before PSI warns are armed (early traffic is noise)
+    ("obs_drift_min_rows", int, 256, ["drift_min_rows"]),
+    # drift monitoring on the serving predict path; off = zero overhead
+    ("serve_drift", bool, True, []),
     # ---- resilience (lightgbm_tpu.resilience; docs/Resilience.md) ----
     # deterministic fault plan: comma list of kind@unit:match[:arg], e.g.
     # "kv_timeout@round:2,kill@iter:7,serve_error@req:50". Strictly
@@ -554,6 +571,15 @@ class Config:
             raise LightGBMError("obs_flight_recorder should be >= 0 "
                                 "(0 = off), got %s"
                                 % self.obs_flight_recorder)
+        if self.obs_drift_warn_psi <= 0:
+            raise LightGBMError("obs_drift_warn_psi should be > 0, got %s"
+                                % self.obs_drift_warn_psi)
+        if not 0.0 < self.obs_drift_decay <= 1.0:
+            raise LightGBMError("obs_drift_decay should be in (0, 1], "
+                                "got %s" % self.obs_drift_decay)
+        if self.obs_drift_min_rows < 0:
+            raise LightGBMError("obs_drift_min_rows should be >= 0, got %s"
+                                % self.obs_drift_min_rows)
         self.serving_backend = str(self.serving_backend).strip().lower()
         if self.serving_backend not in SERVING_BACKENDS:
             raise LightGBMError("serving_backend should be one of %s, got %s"

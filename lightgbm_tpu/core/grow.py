@@ -148,6 +148,15 @@ class GrowParams(NamedTuple):
     # unchanged (pinned by tests/test_obs.py). Off: aux slot stays None
     # and the compiled program is identical to an uninstrumented build.
     obs_health: bool = False
+    # model-statistics piggy-back (lightgbm_tpu.obs.modelstats): the
+    # frontier wave loop additionally threads an f32[F, 3] per-feature
+    # (split count, gain sum, gain max) accumulator through its carry and
+    # returns it alongside health in the aux slot. Like obs_health it is
+    # scatter-updated from the committed lanes the wave already ranked
+    # (zero new sweeps or collectives; psums/wave pinned by
+    # tests/test_modelstats.py). Off: the carry leaf stays None and the
+    # compiled program is byte-identical to an uninstrumented build.
+    obs_modelstats: bool = False
 
 
 class TreeArrays(NamedTuple):

@@ -69,6 +69,7 @@ from jax import lax
 
 from ..bucketing import frontier_max_width, wave_width_ladder
 from ..compat import pcast
+from ..obs.modelstats import init_mstats, update_mstats
 from .histogram import build_histogram, build_histogram_frontier
 from .grow import (GrowParams, TreeArrays, _bin_go_left, _empty_best,
                    decode_bundle_value, empty_tree, expand_hist)
@@ -110,6 +111,9 @@ class _FrontierState(NamedTuple):
     # params.obs_health, else None (empty pytree leaf — the carry and the
     # compiled program are unchanged when monitoring is off)
     health: Optional[jnp.ndarray] = None
+    # [F, MS_WIDTH] f32 per-feature (split count, gain sum, gain max)
+    # when params.obs_modelstats, else None (same empty-leaf contract)
+    mstats: Optional[jnp.ndarray] = None
 
 
 def _gain_anomaly(gain: jnp.ndarray) -> jnp.ndarray:
@@ -158,9 +162,12 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
     """Grow one tree in frontier waves: every positive-gain frontier
     leaf splits per sequential step, with ONE batched histogram pass per
     wave. Same contract as grow.grow_tree (minus forced/CEGB); returns
-    (tree, final per-row leaf_id, aux) where aux is the [2] f32 health
-    accumulator (waves executed, nonfinite committed gain) when
-    ``params.obs_health`` and None otherwise."""
+    (tree, final per-row leaf_id, aux). The aux slot is the [2] f32
+    health accumulator (waves executed, nonfinite committed gain) when
+    ``params.obs_health`` and None otherwise — unless
+    ``params.obs_modelstats``, in which case aux is the 2-tuple
+    ``(health_or_None, mstats)`` with ``mstats`` the f32[F, MS_WIDTH]
+    per-feature (split count, gain sum, gain max) accumulator."""
     n, ncols = xb.shape
     l = params.num_leaves
     b = params.num_bins
@@ -217,11 +224,16 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         health0 = jnp.stack([
             jnp.float32(0.0),
             jnp.any(_gain_anomaly(best0.gain)).astype(jnp.float32)])
+    # model-statistics accumulator (obs.modelstats): zeros are correct —
+    # EVERY committed split, the root's included, flows through a
+    # wave_step commit and scatters there
+    mstats0 = (init_mstats(feature_mask.shape[0])
+               if params.obs_modelstats else None)
     state = _FrontierState(
         leaf_id=leaf_id0, hist_pool=hist_pool, best=best, tree=tree,
         leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
         leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
-        health=health0)
+        health=health0, mstats=mstats0)
 
     def cond_fn(s: _FrontierState) -> jnp.ndarray:
         return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
@@ -308,9 +320,17 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
                                 jnp.maximum(health[1],
                                             bad_gain.astype(jnp.float32))])
 
+        mstats = s.mstats
+        if mstats is not None:
+            # committed lanes' inner feature + ranked gain, values the
+            # wave computed anyway — two scatter-adds + a scatter-max,
+            # zero new collectives
+            mstats = update_mstats(mstats, cur.feature, gval, valid)
+
         return _FrontierState(leaf_id=leaf_id, hist_pool=pool, best=best,
                               tree=tree, leaf_min=leaf_min,
-                              leaf_max=leaf_max, health=health)
+                              leaf_max=leaf_max, health=health,
+                              mstats=mstats)
 
     ladder = wave_width_ladder(l, params.max_depth)  # pow-2 widths, <= kb
     if params.frontier_bucketing and len(ladder) > 1:
@@ -335,4 +355,6 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
             return wave_step(s, kb)
 
     state = lax.while_loop(cond_fn, step, state)
+    if params.obs_modelstats:
+        return state.tree, state.leaf_id, (state.health, state.mstats)
     return state.tree, state.leaf_id, state.health

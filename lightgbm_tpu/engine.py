@@ -171,6 +171,11 @@ def _train_once(params: Dict[str, Any], train_set: Dataset,
         cbs.append(callback.print_evaluation(verbose_eval))
     if evals_result is not None:
         cbs.append(callback.record_evaluation(evals_result))
+    # every evaluated iteration also lands in the process metrics registry
+    # (lgbm_eval_metric gauges) for the stats endpoint / cluster federation;
+    # only_consumes_evals, so eval-free runs still fuse on device
+    if not any(isinstance(c, callback._ExportEvalMetrics) for c in cbs):
+        cbs.append(callback.export_eval_metrics())
     if learning_rates is not None:
         cbs.append(callback.reset_parameter(learning_rate=learning_rates))
     # checkpoint_dir in params auto-attaches the checkpoint callback (the

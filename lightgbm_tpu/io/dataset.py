@@ -132,6 +132,7 @@ class BinnedDataset:
         # update-param checking even when the handle came from a .bin file
         self.bin_params: Dict[str, Any] = {}
         self._device_cache: Dict[Any, Any] = {}
+        self._data_profile = None   # lazy obs.drift.DataProfile cache
 
     _BIN_PARAM_KEYS = ("max_bin", "bin_construct_sample_cnt",
                        "min_data_in_bin", "use_missing", "zero_as_missing",
@@ -529,6 +530,17 @@ class BinnedDataset:
     def max_num_bin(self) -> int:
         return max((self.feature_num_bin(i) for i in range(self.num_features)),
                    default=1)
+
+    def data_profile(self):
+        """Per-feature bin-occupancy profile of the training data
+        (obs.drift.DataProfile), computed lazily from the already-binned
+        matrix — one bincount pass per feature — and cached. Persisted in
+        checkpoint snapshot meta and the serving ModelBundle as the
+        reference distribution for train/serve drift scoring."""
+        if self._data_profile is None:
+            from ..obs.drift import DataProfile
+            self._data_profile = DataProfile.from_binned_dataset(self)
+        return self._data_profile
 
     # ------------------------------------------------------------ EFB layout
     @property

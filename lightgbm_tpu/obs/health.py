@@ -105,6 +105,10 @@ class HealthMonitor:
             "lgbm_train_straggler_reports_total",
             "Straggler-skew reports routed through the health monitor "
             "(warn-only; stragglers never escalate).")
+        self._c_drift = reg.counter(
+            "lgbm_drift_reports_total",
+            "Train/serve drift reports routed through the health monitor "
+            "(warn-only; drift never escalates).")
 
     def anomaly_count(self) -> int:
         return int(self._c_anomaly.value)
@@ -126,6 +130,27 @@ class HealthMonitor:
             self._events.write("health", iteration=r.iteration, kind=r.kind,
                                message=r.message, process=int(process),
                                skew=round(float(skew), 4))
+        Log.warning("health: %s" % r.message)
+        return r
+
+    def note_drift(self, model_id: str, features: str, max_psi: float,
+                   threshold: float, rows: int = 0) -> HealthReport:
+        """Record a train/serve drift crossing from obs.drift.  Like
+        stragglers, drift warns and counts but NEVER escalates — shifted
+        traffic is a refit trigger, not a reason to kill a server that is
+        still answering correctly for its training distribution."""
+        r = HealthReport(
+            0, "data_drift",
+            "model %s: serving traffic drifted from the training profile "
+            "(max PSI %.3f >= warn threshold %.3f over %d rows; %s)"
+            % (model_id, float(max_psi), float(threshold), int(rows),
+               features))
+        self.reports.append(r)
+        self._c_drift.inc()
+        if self._events is not None:
+            self._events.write("health", iteration=0, kind=r.kind,
+                               message=r.message, model=str(model_id),
+                               max_psi=round(float(max_psi), 4))
         Log.warning("health: %s" % r.message)
         return r
 

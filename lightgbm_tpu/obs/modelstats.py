@@ -1,0 +1,241 @@
+"""Model statistics: split-gain introspection + streaming importance.
+
+Two halves, mirroring obs/health.py's device/host split:
+
+Device side — a per-tree ``f32[F, MS_WIDTH]`` accumulator piggy-backed on
+the frontier grower's wave loop (``_FrontierState.mstats``), scatter-added
+from values every wave ALREADY computed: the committed lanes' feature
+indices and top-k gains both derive from the per-wave psum'd histograms,
+so the accumulator adds ZERO collectives (tests/test_modelstats.py pins
+psums/wave with modelstats ON) and, being an ``Optional`` carry leaf that
+is ``None`` when off, leaves the compiled program byte-identical when
+``obs_modelstats`` is not set.
+
+Host side — ``ModelStats`` ingests the fetched accumulators (or, on
+growth paths without the piggy-back, recomputes from the materialized
+HostTrees) exactly at flush time, so its cumulative state tracks the KEPT
+model list even across device-detected stops.  It streams:
+
+- ``lgbm_model_split_count/gain_total/gain_max{feature=}`` gauges,
+- ``lgbm_model_leaf_value`` / ``lgbm_model_leaf_depth`` summaries,
+- ``lgbm_model_trees`` / ``lgbm_model_gain_mass`` / new-leaf gauges,
+- per-iteration ``model_iter`` EventStream records (the learning-curve
+  companion to engine.train's ``lgbm_eval_metric`` gauges),
+
+and answers ``importance("split"|"gain")`` with reference-LightGBM
+semantics (per ORIGINAL feature index; gains summed over every committed
+split) — tested for exact agreement with ``GBDT.feature_importance``'s
+host-side recomputation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .registry import MetricsRegistry, get_registry
+
+# layout of the device accumulator: f32[F_inner, MS_WIDTH] per grown tree
+MS_COUNT = 0      # committed splits on the feature
+MS_GAIN_SUM = 1   # total committed split gain
+MS_GAIN_MAX = 2   # max committed split gain
+MS_WIDTH = 3
+
+
+def init_mstats(num_features: int):
+    """Zero accumulator seeded into the frontier state (root wave)."""
+    import jax.numpy as jnp
+    return jnp.zeros((int(num_features), MS_WIDTH), jnp.float32)
+
+
+def update_mstats(mstats, feature, gain, valid):
+    """Scatter one wave's committed splits into the accumulator.
+
+    ``feature``/``gain``/``valid`` are the wave's ``[kw]`` top-k lanes
+    (inner feature index, ranked gain, commit mask) — values the wave step
+    computed anyway from the psum'd histograms, so the update is two
+    scatter-adds and a scatter-max with no new sweeps or collectives.
+    Invalid lanes route to row ``F`` and drop.
+    """
+    import jax.numpy as jnp
+    f = mstats.shape[0]
+    idx = jnp.where(valid, feature.astype(jnp.int32), f)
+    g = jnp.where(valid, gain, 0.0)
+    m = mstats.at[idx, MS_COUNT].add(valid.astype(jnp.float32), mode="drop")
+    m = m.at[idx, MS_GAIN_SUM].add(g, mode="drop")
+    m = m.at[idx, MS_GAIN_MAX].max(g, mode="drop")
+    return m
+
+
+def leaf_depths(ht) -> np.ndarray:
+    """Per-leaf depths of a HostTree, replayed from the split order.
+
+    Node ``t`` splits leaf ``split_leaf[t]``; the left child keeps the
+    parent's leaf index and the right child becomes leaf ``t + 1`` (the
+    numbering _replay_leaves_binned routes by), so one pass over the
+    nodes in commit order reconstructs every leaf's final depth."""
+    nl = int(getattr(ht, "num_leaves_actual", ht.num_leaves))
+    depth = np.zeros(max(nl, 1), np.int32)
+    for t in range(nl - 1):
+        leaf = int(ht.split_leaf[t])
+        if leaf < 0:
+            continue
+        d = depth[leaf] + 1
+        depth[leaf] = d
+        depth[t + 1] = d
+    return depth[:max(nl, 1)]
+
+
+class ModelStats:
+    """Cumulative training-side model statistics (host half).
+
+    ``inner_to_real`` maps the device accumulator's inner (stored)
+    feature indices to original dataset indices — the same map
+    ``_extract_host_tree`` applies to split features — so device-fed and
+    tree-fed statistics land in the same per-feature slots."""
+
+    def __init__(self, num_features: int,
+                 feature_names: Optional[List[str]] = None,
+                 inner_to_real=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 events=None):
+        self.num_features = int(num_features)
+        self.feature_names = (list(feature_names) if feature_names
+                              else ["Column_%d" % i
+                                    for i in range(self.num_features)])
+        self._inner_to_real = (np.asarray(inner_to_real, np.int64)
+                               if inner_to_real is not None else None)
+        self.split_count = np.zeros(self.num_features, np.float64)
+        self.gain_total = np.zeros(self.num_features, np.float64)
+        self.gain_max = np.zeros(self.num_features, np.float64)
+        self.trees = 0
+        self.iterations = 0
+        self._events = events
+        reg = registry if registry is not None else get_registry()
+        self._reg = reg
+        self._g_trees = reg.gauge(
+            "lgbm_model_trees", "Materialized trees in the model so far.")
+        self._g_gain_mass = reg.gauge(
+            "lgbm_model_gain_mass",
+            "Cumulative split gain across all features and trees.")
+        self._g_new_leaves = reg.gauge(
+            "lgbm_model_new_leaves_last",
+            "Leaves grown by the most recent materialized iteration.")
+        self._s_leaf_value = reg.summary(
+            "lgbm_model_leaf_value",
+            "Leaf output values of materialized trees (post-shrinkage).")
+        self._s_leaf_depth = reg.summary(
+            "lgbm_model_leaf_depth",
+            "Leaf depths of materialized trees.")
+        self._feat_gauges = {}
+
+    # ------------------------------------------------------------ ingest
+    def _real_index(self, inner: int) -> int:
+        if self._inner_to_real is None:
+            return inner if inner < self.num_features else -1
+        if inner >= len(self._inner_to_real):
+            return -1   # mesh feature padding: never splits, never counted
+        return int(self._inner_to_real[inner])
+
+    def ingest_device(self, rows) -> float:
+        """Fold one KEPT iteration's device accumulators ``[K, F_inner,
+        MS_WIDTH]`` into the cumulative per-feature state; returns the
+        iteration's gain mass."""
+        rows = np.asarray(rows, np.float64)
+        if rows.ndim == 2:
+            rows = rows[None]
+        agg = rows.sum(axis=0)                     # [F, W] count/gain sums
+        mx = rows[..., MS_GAIN_MAX].max(axis=0)    # [F]
+        for i in np.nonzero(agg[:, MS_COUNT] > 0)[0]:
+            j = self._real_index(int(i))
+            if j < 0:
+                continue
+            self.split_count[j] += agg[i, MS_COUNT]
+            self.gain_total[j] += agg[i, MS_GAIN_SUM]
+            self.gain_max[j] = max(self.gain_max[j], float(mx[i]))
+        return float(agg[:, MS_GAIN_SUM].sum())
+
+    def _ingest_tree_splits(self, ht) -> float:
+        """Host fallback (exact/mesh growth paths): fold one materialized
+        tree's committed splits from its arrays.  ``split_feature`` is
+        already in ORIGINAL index space here."""
+        mass = 0.0
+        for i in range(int(getattr(ht, "num_leaves_actual",
+                                   ht.num_leaves)) - 1):
+            if ht.split_leaf[i] < 0:
+                continue
+            j = int(ht.split_feature[i])
+            g = float(ht.split_gain[i])
+            if 0 <= j < self.num_features:
+                self.split_count[j] += 1
+                self.gain_total[j] += g
+                self.gain_max[j] = max(self.gain_max[j], g)
+            mass += g
+        return mass
+
+    def ingest_iteration(self, host_trees, iteration: int,
+                         device_rows=None) -> None:
+        """One KEPT iteration's class trees at materialize time.
+
+        ``device_rows`` is the frontier piggy-back's ``[K, F_inner,
+        MS_WIDTH]`` fetch when available; without it (exact mode, mesh
+        learners) the split statistics recompute from the trees."""
+        new_leaves = 0
+        for ht in host_trees:
+            self.trees += 1
+            nl = int(getattr(ht, "num_leaves_actual", ht.num_leaves))
+            new_leaves += nl
+            for d in leaf_depths(ht):
+                self._s_leaf_depth.observe(float(d))
+            for v in np.asarray(ht.leaf_value[:max(nl, 1)], np.float64):
+                self._s_leaf_value.observe(float(v))
+        if device_rows is not None:
+            gain_mass = self.ingest_device(device_rows)
+        else:
+            gain_mass = sum(self._ingest_tree_splits(ht)
+                            for ht in host_trees)
+        self.iterations += 1
+        self._publish(new_leaves)
+        if self._events is not None:
+            self._events.write("model_iter", iteration=int(iteration),
+                               trees=self.trees,
+                               new_leaves=int(new_leaves),
+                               gain_iter=round(float(gain_mass), 6),
+                               gain_mass=round(float(self.gain_total.sum()),
+                                               6))
+
+    # ------------------------------------------------------------ export
+    def _gauges_for(self, j: int):
+        g = self._feat_gauges.get(j)
+        if g is None:
+            name = (self.feature_names[j] if j < len(self.feature_names)
+                    else "Column_%d" % j)
+            lbl = {"feature": name}
+            g = (self._reg.gauge("lgbm_model_split_count",
+                                 "Committed splits per feature.", lbl),
+                 self._reg.gauge("lgbm_model_gain_total",
+                                 "Total committed split gain per feature.",
+                                 lbl),
+                 self._reg.gauge("lgbm_model_gain_max",
+                                 "Largest committed split gain per feature.",
+                                 lbl))
+            self._feat_gauges[j] = g
+        return g
+
+    def _publish(self, new_leaves: int) -> None:
+        self._g_trees.set(self.trees)
+        self._g_gain_mass.set(float(self.gain_total.sum()))
+        self._g_new_leaves.set(new_leaves)
+        for j in np.nonzero(self.split_count > 0)[0]:
+            gc, gt, gm = self._gauges_for(int(j))
+            gc.set(float(self.split_count[j]))
+            gt.set(float(self.gain_total[j]))
+            gm.set(float(self.gain_max[j]))
+
+    def importance(self, importance_type: str = "split") -> np.ndarray:
+        """Streaming feature importance over ORIGINAL feature indices —
+        reference semantics (``GBDT.feature_importance`` recomputes the
+        same quantity from the tree dump; tests pin agreement)."""
+        src = (self.split_count if importance_type == "split"
+               else self.gain_total)
+        return np.array(src, np.float64)

@@ -14,6 +14,11 @@ metrics registry while a training run is live:
   scrape never triggers a collective.  Single-process (or before
   ``StatsServer.set_cluster`` wires a provider) these are exactly the
   local ``/metrics`` / ``/stats`` bodies.
+- ``GET /drift``    -> per-model train/serve drift status (obs/drift.py):
+  every registered DriftMonitor's PSI/JS per feature + score sketch, or
+  ``{"status": "no_profile"}`` when nothing monitors drift here.  The
+  per-feature numbers also live in the registry as ``lgbm_drift_*``
+  gauges, so the cluster routes federate them automatically.
 
 Enabled via ``obs_stats_port`` (>= 0; 0 binds an OS-assigned port whose
 number is exported in ``StatsServer.port`` and logged).  A busy port is
@@ -83,6 +88,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "anomalies": n,
                 }).encode()
                 self._send(200, body, "application/json")
+            elif self.path == "/drift":
+                # lazy import mirrors /roofline: the route reads the
+                # process-wide monitor registry, populated by serving (or
+                # anything that register_monitor()s)
+                from .drift import drift_snapshot
+                self._send(200, json.dumps(drift_snapshot(),
+                                           sort_keys=True).encode(),
+                           "application/json")
             elif self.path == "/roofline":
                 # lazy import: costmodel itself is jax-free at module
                 # scope, but keep the server importable even if it ever
@@ -144,7 +157,7 @@ class StatsServer:
             name="lgbm-obs-stats", daemon=True)
         self._thread.start()
         Log.info("obs: stats endpoint on http://%s:%d (metrics/stats/"
-                 "healthz/roofline)" % (self.host, self.port))
+                 "healthz/roofline/drift)" % (self.host, self.port))
         return self
 
     def stop(self) -> None:

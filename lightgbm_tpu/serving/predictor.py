@@ -134,7 +134,9 @@ class ServingEngine:
                  cascade_margin: float = 10.0,
                  quantize_leaves: bool = False,
                  guard_hot_roll: bool = True, canary_rows: int = 16,
-                 roll_max_latency_ms: float = 0.0):
+                 roll_max_latency_ms: float = 0.0,
+                 drift: bool = True, drift_warn_psi: float = 0.25,
+                 drift_min_rows: int = 256, drift_decay: float = 0.999):
         check(max_batch >= 1 and min_bucket >= 1,
               "serve_max_batch and serve_min_bucket must be >= 1")
         check(backend in SERVING_BACKENDS,
@@ -158,6 +160,17 @@ class ServingEngine:
         self.mesh = serving_mesh(num_devices) if num_devices != 1 else None
         self._cache: Dict[Tuple, _CompiledPredictor] = {}
         self._lock = threading.Lock()
+        # train/serve drift (obs/drift.py): one DriftMonitor per live
+        # (model, generation), created lazily on the first predict so a
+        # pre-profile bundle costs one dict lookup per request and a
+        # profile-less registry costs nothing at boot
+        self.drift_enabled = bool(drift)
+        self.drift_warn_psi = float(drift_warn_psi)
+        self.drift_min_rows = int(drift_min_rows)
+        self.drift_decay = float(drift_decay)
+        self._drift: Dict[str, Tuple[int, object]] = {}
+        self._drift_hooks: List = []   # attached to every (future) monitor
+        self._health_monitor = None  # lazy HealthMonitor, warn-only routing
         # atomic re-registration (checkpoint hot-roll): purge this model's
         # compiled predictors when its bundle is swapped
         self.registry.add_replace_listener(self._invalidate_model)
@@ -174,6 +187,13 @@ class ServingEngine:
             for key in [k for k in self._cache
                         if k[0] == model_id and k[1] != current]:
                 del self._cache[key]
+            held = self._drift.get(model_id)
+            if held is not None and held[0] != current:
+                # the new generation may carry a different (or no) training
+                # profile — drop the monitor; the next predict rebuilds it
+                del self._drift[model_id]
+                from ..obs.drift import unregister_monitor
+                unregister_monitor(model_id)
 
     def _predictor(self, bundle: ModelBundle, bucket: int, raw_score: bool,
                    iters: int) -> _CompiledPredictor:
@@ -197,6 +217,74 @@ class ServingEngine:
     def cache_size(self) -> int:
         with self._lock:
             return len(self._cache)
+
+    # ------------------------------------------------------------ drift
+    def drift_monitor(self, bundle: ModelBundle):
+        """The DriftMonitor for ``bundle``'s current generation (created
+        and ``register_monitor``-ed on first use, so ``/drift`` and the
+        cluster federation see it).  A monitor exists even when the bundle
+        carries no training profile — it then reports ``no_profile``
+        instead of silently vanishing from the status surfaces.  Returns
+        None only when drift monitoring is disabled engine-wide."""
+        if not self.drift_enabled:
+            return None
+        gen = getattr(bundle, "generation", 0)
+        with self._lock:
+            held = self._drift.get(bundle.model_id)
+            if held is not None and held[0] == gen:
+                return held[1]
+        from ..obs.drift import DriftMonitor, register_monitor
+        mon = DriftMonitor(
+            getattr(bundle, "profile", None), model_id=bundle.model_id,
+            warn_psi=self.drift_warn_psi, min_rows=self.drift_min_rows,
+            decay=self.drift_decay, monitor=self._drift_health())
+        for hook in list(self._drift_hooks):
+            mon.on_drift(hook)
+        with self._lock:
+            held = self._drift.get(bundle.model_id)
+            if held is not None and held[0] == gen:
+                return held[1]   # raced another request; keep the winner
+            self._drift[bundle.model_id] = (gen, mon)
+        register_monitor(mon)
+        return mon
+
+    def add_drift_hook(self, hook) -> None:
+        """Subscribe ``hook(report_dict)`` to ok->warn drift transitions
+        of EVERY model this engine serves — current monitors and ones not
+        yet created (they are lazy, per generation).  This is how
+        ``CheckpointWatcher`` arms its refit-trigger poll without knowing
+        which bundle will drift first."""
+        self._drift_hooks.append(hook)
+        with self._lock:
+            monitors = [held[1] for held in self._drift.values()]
+        for mon in monitors:
+            mon.on_drift(hook)
+
+    def _drift_health(self):
+        """Warn-only HealthMonitor shared by this engine's drift monitors
+        (note_drift never escalates, so ``action="warn"`` is exact)."""
+        if self._health_monitor is None:
+            from ..obs.health import HealthMonitor
+            self._health_monitor = HealthMonitor(action="warn")
+        return self._health_monitor
+
+    def drift_status(self) -> Dict:
+        """Worst drift status across this engine's live monitors — the
+        ``drift`` field of the serving ``/healthz`` payload.  ``disabled``
+        when the engine runs with ``serve_drift=false``; ``no_profile``
+        when no monitored model carries a training profile yet."""
+        if not self.drift_enabled:
+            return {"status": "disabled", "models": {}}
+        with self._lock:
+            monitors = [held[1] for held in self._drift.values()]
+        rank = {"warn": 2, "ok": 1, "no_profile": 0}
+        worst, models = "no_profile", {}
+        for mon in monitors:
+            st = mon.status()
+            models[st.get("model", "")] = st
+            if rank.get(st["status"], 0) > rank[worst]:
+                worst = st["status"]
+        return {"status": worst, "models": models}
 
     # ------------------------------------------------------------ predict
     def predict(self, model_id: str, X, raw_score: bool = False,
@@ -240,6 +328,14 @@ class ServingEngine:
         out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
         if bundle.num_tree_per_iteration == 1:
             out = out[:, 0]
+        if self.drift_enabled:
+            mon = self.drift_monitor(bundle)
+            if mon is not None:
+                try:
+                    mon.observe(X, scores=out)
+                except Exception as e:  # diagnostics must not fail serving
+                    Log.debug("drift observe failed for %r: %s",
+                              model_id, e)
         if _record_request:
             self.metrics.record_request(n, time.perf_counter() - t0)
         return out
@@ -384,6 +480,15 @@ class ServingEngine:
         """Score canary rows on the STAGED bundle; raise LightGBMError on
         any failed check. Runs inside the stage_and_prewarm credit window
         so nothing here counts as a serving recompile."""
+        if getattr(bundle, "profile", None) is None:
+            # warn, don't refuse: pre-profile snapshots/model files are
+            # valid models — they just cannot be drift-monitored, and the
+            # /drift route will say "no_profile" for them
+            Log.warning(
+                "staged model %r carries no training data profile "
+                "(pre-profile snapshot or bare model file); train/serve "
+                "drift detection is unavailable for this generation",
+                bundle.model_id)
         X = self._canary(bundle)
         iters = bundle.effective_iterations(None)
         b = bucket_rows(X.shape[0], self.min_bucket, self.max_batch)

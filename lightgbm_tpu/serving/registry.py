@@ -21,6 +21,29 @@ import numpy as np
 from ..log import LightGBMError, check
 
 
+def _sibling_profile(model_path: str):
+    """Recover the training data profile for a model-text file from the
+    checkpoint meta.json written next to it (``snap_N.model.txt`` ->
+    ``snap_N.meta.json``).  Snapshots double as servable models, and the
+    profile travels in their JSON meta — this is how a hot-rolled bundle
+    gets its drift reference.  Returns None for bare model files or
+    pre-profile snapshots (always legal)."""
+    import json
+    import os
+    if not model_path.endswith(".model.txt"):
+        return None
+    meta_path = model_path[:-len(".model.txt")] + ".meta.json"
+    if not os.path.exists(meta_path):
+        return None
+    try:
+        with open(meta_path, "r") as fh:
+            meta = json.load(fh)
+        from ..obs.drift import DataProfile
+        return DataProfile.from_json_dict(meta.get("data_profile"))
+    except Exception:  # noqa: BLE001 - a corrupt sibling never blocks a load
+        return None
+
+
 class ModelBundle:
     """One loaded model, ready to serve.
 
@@ -34,7 +57,8 @@ class ModelBundle:
                  num_features: int, objective=None,
                  average_output: bool = False,
                  feature_names: Optional[List[str]] = None,
-                 pandas_categorical=None, host_models=None):
+                 pandas_categorical=None, host_models=None,
+                 profile=None):
         self.model_id = model_id
         self.trees = trees
         self.num_class = num_class
@@ -50,6 +74,12 @@ class ModelBundle:
         # traversal's SoA pack (serving/traversal.py); None disables the
         # traversal backend for this bundle (replay fallback)
         self.host_models = host_models
+        # training data profile (obs.drift.DataProfile) or None: the
+        # reference distribution drift monitoring scores against.
+        # Optional EVERYWHERE — models loaded from bare text files or
+        # pre-profile snapshots legally carry none (drift reports
+        # "no_profile" for them)
+        self.profile = profile
         self._capped: Dict[int, "jnp.ndarray"] = {}
         self._flat: Dict[bool, tuple] = {}        # quantize -> (forest, depth)
         self._flat_capped: Dict[tuple, object] = {}
@@ -73,12 +103,18 @@ class ModelBundle:
         nf = len(feature_names) if feature_names else int(max(
             (int(np.max(t.split_feature, initial=0)) for t in models),
             default=0)) + 1
+        profile = None
+        if getattr(impl, "train_data", None) is not None:
+            try:
+                profile = impl.train_data.data_profile()
+            except Exception:  # noqa: BLE001 - profile is best-effort
+                profile = None
         return cls(model_id, trees, num_class=impl.num_class, k=k,
                    num_features=nf, objective=impl.objective,
                    average_output=impl.average_output,
                    feature_names=feature_names,
                    pandas_categorical=pandas_categorical,
-                   host_models=list(models[:total]))
+                   host_models=list(models[:total]), profile=profile)
 
     @classmethod
     def from_booster(cls, model_id: str, booster) -> "ModelBundle":
@@ -165,6 +201,8 @@ class ModelRegistry:
         parse_model_file(path)   # fail fast with a format error, not mid-serve
         booster = Booster(model_file=path)
         bundle = ModelBundle.from_booster(model_id, booster)
+        if bundle.profile is None:
+            bundle.profile = _sibling_profile(path)
         with self._lock:
             bundle.generation = self._generation.get(model_id, 0) + 1
         return bundle
@@ -259,6 +297,11 @@ class CheckpointWatcher:
         self._rejected_ids: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        if engine is not None and hasattr(engine, "add_drift_hook"):
+            # refit trigger: a drift warn on ANY model this engine serves
+            # polls the checkpoint directory immediately (off-thread) —
+            # see arm_drift_refit for the contract
+            engine.add_drift_hook(self._drift_poll)
 
     def poll(self) -> bool:
         """One check: register the newest valid snapshot if it is newer
@@ -297,6 +340,35 @@ class CheckpointWatcher:
         Log.info("serving: hot-rolled snapshot %d from %s into model %r",
                  snap_id, self.checkpoint_dir, self.model_id)
         return True
+
+    def arm_drift_refit(self, monitor) -> None:
+        """Subscribe this watcher to a DriftMonitor (obs/drift.py): when
+        serving traffic drifts past the warn threshold, poll the
+        checkpoint directory immediately — if a refit loop has produced a
+        newer snapshot, it hot-rolls in without waiting out the poll
+        interval. This is the refit-trigger contract from
+        docs/Observability.md: the hook never trains anything itself; it
+        closes the loop between "the data moved" and "pick up the
+        retrained model". Watchers built with ``engine=`` arm themselves
+        through ``ServingEngine.add_drift_hook`` — this method is the
+        manual seam for monitors created outside an engine."""
+        monitor.on_drift(self._drift_poll)
+
+    def _drift_poll(self, report) -> None:
+        """Drift hooks fire on the serving request thread that crossed
+        the threshold — the poll (which may compile a staged bundle) runs
+        on its own daemon thread so the triggering request never waits."""
+        t = threading.Thread(target=self._safe_poll, daemon=True,
+                             name="ckpt-drift-poll-%s" % self.model_id)
+        t.start()
+
+    def _safe_poll(self) -> None:
+        try:
+            self.poll()
+        except Exception as e:  # noqa: BLE001 - keep serving alive
+            from ..log import Log
+            Log.warning("drift-triggered checkpoint poll %r: %s",
+                        self.model_id, e)
 
     def start(self) -> "CheckpointWatcher":
         if self._thread is not None:

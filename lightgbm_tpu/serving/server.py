@@ -13,7 +13,11 @@ HTTP API:
   GET  /metrics   one ServingMetrics snapshot (docs/Serving.md schema)
   GET  /metrics/prometheus   process-wide obs registry, Prometheus text
                   exposition 0.0.4 (serving + compile + training series)
-  GET  /healthz   {"status": "ok", "models": [...]}
+  GET  /healthz   {"status": "ok", "models": [...], "drift": "ok"|"warn"|
+                   "no_profile"|"disabled"} — drift fed by the engine's
+                  DriftMonitors (obs/drift.py; warn-only, never 503s)
+  GET  /drift     per-model train/serve drift detail: PSI/JS per feature
+                  vs the bundled training profile + the score sketch
   GET  /models    registered model ids + shapes
 
 stdin mode (``serve_stdin=true``) speaks the same request objects, one JSON
@@ -138,8 +142,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             brk = self.app.breaker.snapshot()
             code = 200 if brk["state"] != "open" else 503
+            # drift is advisory: a drifted model still answers correctly
+            # for its training distribution, so "warn" never turns the
+            # probe 503 — it flags the refit loop, not the load balancer
             self._reply(code, {"status": "ok" if code == 200 else "degraded",
                                "models": self.app.engine.registry.ids(),
+                               "drift":
+                                   self.app.engine.drift_status()["status"],
                                "breaker": brk})
         elif self.path == "/stats":
             snap = self.app.engine.metrics.snapshot()
@@ -152,6 +161,12 @@ class _Handler(BaseHTTPRequestHandler):
             # a scrape sees serving, compile-cache and training series
             self._reply_raw(200, get_registry().prometheus_text().encode(),
                             "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/drift":
+            # same body as the training StatsServer's /drift: the process
+            # -wide monitor registry, which this engine's lazily-created
+            # monitors publish into
+            from ..obs.drift import drift_snapshot
+            self._reply(200, drift_snapshot())
         elif self.path == "/models":
             self._reply(200, self.app.handle_models())
         else:
@@ -233,7 +248,11 @@ def build_app(config: Config) -> ServingApp:
         quantize_leaves=config.serving_quantize_leaves,
         guard_hot_roll=config.serve_guard_hot_roll,
         canary_rows=config.serve_canary_rows,
-        roll_max_latency_ms=config.serve_roll_max_latency_ms)
+        roll_max_latency_ms=config.serve_roll_max_latency_ms,
+        drift=config.serve_drift,
+        drift_warn_psi=config.obs_drift_warn_psi,
+        drift_min_rows=config.obs_drift_min_rows,
+        drift_decay=config.obs_drift_decay)
     if config.input_model:
         engine.registry.load_file("default", config.input_model)
     app = ServingApp(

@@ -1,0 +1,400 @@
+"""Model & data observability: modelstats piggy-back + train/serve drift.
+
+Pins the PR's acceptance contract (docs/Observability.md "Model
+statistics & drift"):
+
+- ``feature_importance("split"|"gain")`` agrees exactly with the
+  streaming ModelStats accumulator on BOTH growth paths (device-fed
+  frontier piggy-back, host-tree fallback);
+- with ``obs_modelstats`` off the compiled frontier program is
+  byte-identical (same jaxpr fingerprint) and with it ON the per-wave
+  psum count is UNCHANGED — the accumulator rides values the wave
+  already reduced;
+- PSI golden values and the equal-mass bucketing that keeps sampling
+  noise below the warn threshold;
+- the serving DriftMonitor warns (and fires on_drift) on shifted
+  traffic within a bounded number of batches, stays quiet on
+  same-distribution traffic, and reports ``no_profile`` explicitly;
+- the training data profile survives checkpoint -> snapshot ->
+  ``stage_file`` and pre-profile snapshots still load (back-compat);
+- per-host ``lgbm_drift_*`` gauges federate through the PR 9
+  Prometheus merge.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import callback, engine
+from lightgbm_tpu.obs.drift import (DataProfile, DriftMonitor, drift_snapshot,
+                                    psi, psi_buckets, js_divergence,
+                                    register_monitor, unregister_monitor)
+from lightgbm_tpu.obs.registry import MetricsRegistry
+
+
+def _data(n=400, f=6, seed=3, loc=0.0, scale=1.0):
+    r = np.random.RandomState(seed)
+    X = (r.randn(n, f) * scale + loc).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * r.randn(n)).astype(np.float32)
+    return X, y
+
+
+_BASE = dict(objective="regression", num_leaves=12, learning_rate=0.1,
+             min_data_in_leaf=5, verbosity=0, obs_modelstats=True)
+
+
+def _train(params, num_rounds=10, ckpt_dir=None, X=None, y=None):
+    if X is None:
+        X, y = _data()
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    cbs = []
+    if ckpt_dir is not None:
+        cbs.append(callback.checkpoint(ckpt_dir, period=1))
+    return engine.train(dict(params), ds, num_boost_round=num_rounds,
+                        callbacks=cbs, verbose_eval=False)
+
+
+# ---------------------------------------------------- importance parity
+@pytest.mark.parametrize("growth", ["frontier", "batched"])
+def test_importance_matches_host_recomputation(growth):
+    """The streaming accumulator (device-fed on frontier, tree-fed on the
+    fallback) must agree with GBDT.feature_importance's host-side
+    recomputation from the materialized trees — split counts exactly,
+    gains to f32 summation order."""
+    bst = _train(dict(_BASE, tree_growth=growth))
+    ms = bst._impl._modelstats
+    assert ms is not None and ms.trees == 10
+    np.testing.assert_array_equal(
+        ms.importance("split"),
+        bst.feature_importance("split").astype(np.float64))
+    np.testing.assert_allclose(
+        ms.importance("gain"), bst.feature_importance("gain"),
+        rtol=1e-3, atol=1e-2)
+    assert ms.importance("split").sum() > 0      # the model really split
+
+
+def test_modelstats_off_leaves_no_trace():
+    bst = _train(dict(_BASE, obs_modelstats=False), num_rounds=3)
+    assert bst._impl._modelstats is None
+
+
+# ------------------------------------------- compiled-program invariance
+def test_modelstats_off_keeps_jaxpr_identical():
+    """obs_modelstats=False must produce the EXACT compiled program of an
+    uninstrumented build — the accumulator is a None carry leaf, invisible
+    to tracing (same guarantee tools/analyze.py --audit pins repo-wide)."""
+    import jax
+    from lightgbm_tpu.analysis import jaxpr_audit
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+
+    def fingerprint(overrides):
+        fn, args, _ = jaxpr_audit.sharded_frontier_fn(
+            param_overrides=overrides)
+        return jaxpr_audit.structural_fingerprint(
+            jax.make_jaxpr(fn)(*args))
+
+    assert fingerprint(None) == fingerprint({"obs_modelstats": False})
+
+
+def test_modelstats_on_adds_no_collectives():
+    """Acceptance: psums/wave UNCHANGED with the accumulator on — it
+    scatters values the wave already ranked from the psum'd histograms."""
+    import jax
+    from lightgbm_tpu.analysis import jaxpr_audit
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+
+    def psum_count(on):
+        fn, args, _ = jaxpr_audit.sharded_frontier_fn(
+            param_overrides={"obs_modelstats": on})
+        counts = jaxpr_audit.count_collectives(jax.make_jaxpr(fn)(*args))
+        return counts.get("psum", 0)
+
+    n_off = psum_count(False)
+    assert n_off > 0
+    assert psum_count(True) == n_off
+
+
+# ------------------------------------------------------------ PSI math
+def test_psi_golden_values():
+    assert psi([100, 100, 100], [100, 100, 100]) == pytest.approx(0.0)
+    assert psi([50, 200, 50], [50, 200, 50]) == pytest.approx(0.0)
+    # fully disjoint mass: epsilon-floored, large and finite
+    disjoint = psi([1000, 0, 0], [0, 0, 1000])
+    assert np.isfinite(disjoint) and disjoint > 5.0
+    # scale-invariant: proportions, not raw counts
+    assert psi([10, 20, 30], [100, 200, 300]) == pytest.approx(0.0, abs=1e-9)
+    assert js_divergence([100, 0], [0, 100]) <= np.log(2) + 1e-12
+    assert js_divergence([7, 7], [7, 7]) == pytest.approx(0.0)
+
+
+def test_psi_buckets_tames_sampling_noise():
+    """PSI over hundreds of fine bins is dominated by sampling noise
+    (expectation ~ (B-1)(1/Ne + 1/Na) for IDENTICAL distributions); the
+    equal-mass bucketing must pull two same-distribution samples well
+    under the 0.25 warn threshold while leaving true shifts large."""
+    r = np.random.RandomState(0)
+    edges = np.linspace(-4, 4, 256)
+    a = np.histogram(r.randn(500), bins=edges)[0]
+    b = np.histogram(r.randn(300), bins=edges)[0]
+    assert psi(a, b) > 0.25                      # fine bins: noise dominates
+    agg = psi_buckets(a, 10)
+    assert int(agg.max()) + 1 <= 10
+    ab = np.bincount(agg, weights=a, minlength=int(agg.max()) + 1)
+    bb = np.bincount(agg, weights=b, minlength=int(agg.max()) + 1)
+    assert psi(ab, bb) < 0.1                     # bucketed: stable reads ok
+    shifted = np.histogram(r.randn(300) + 3.0, bins=edges)[0]
+    sb = np.bincount(agg, weights=shifted, minlength=int(agg.max()) + 1)
+    assert psi(ab, sb) > 1.0                     # a real shift stays loud
+    # few-bin features keep their bins 1:1
+    np.testing.assert_array_equal(psi_buckets([5, 5, 5], 10), [0, 1, 2])
+
+
+# ----------------------------------------------------- drift monitoring
+def _profile():
+    X, y = _data(n=500)
+    ds = lgb.Dataset(X, label=y, params=dict(_BASE))
+    ds.construct()
+    return ds._binned.data_profile(), X.shape[1]
+
+
+def test_drift_monitor_warns_on_shift_not_on_noise():
+    profile, f = _profile()
+    fired = []
+    mon = DriftMonitor(profile, model_id="t", warn_psi=0.25, min_rows=128,
+                       eval_every=64)
+    mon.on_drift(fired.append)
+    r = np.random.RandomState(1)
+    for _ in range(4):
+        mon.observe(r.randn(64, f).astype(np.float32), scores=r.randn(64))
+    st = mon.status()
+    assert st["status"] == "ok" and st["max_psi"] < 0.25
+    assert not fired
+    # shifted stream: warn within 6 batches of 64 rows
+    for _ in range(6):
+        mon.observe((r.randn(64, f) * 3 + 6).astype(np.float32))
+    st = mon.status()
+    assert st["status"] == "warn"
+    assert st["max_psi"] >= 0.25
+    assert len(fired) == 1                       # edge-triggered, once
+    assert fired[0]["model"] == "t" and fired[0]["max_psi"] >= 0.25
+    assert st["score_sketch"]["rows"] == 256
+
+
+def test_drift_monitor_without_profile_is_explicit():
+    mon = DriftMonitor(None, model_id="old")
+    mon.observe(np.zeros((32, 4), np.float32), scores=np.zeros(32))
+    assert mon.status()["status"] == "no_profile"
+    assert not mon.has_profile
+    register_monitor(mon)
+    try:
+        snap = drift_snapshot()
+        assert snap["models"]["old"]["status"] == "no_profile"
+    finally:
+        unregister_monitor("old")
+
+
+def test_drift_routes_through_health_monitor():
+    from lightgbm_tpu.obs.health import HealthMonitor
+    reg = MetricsRegistry()
+    hm = HealthMonitor(action="warn", registry=reg)
+    profile, f = _profile()
+    mon = DriftMonitor(profile, model_id="h", warn_psi=0.2, min_rows=64,
+                       eval_every=64, registry=reg, monitor=hm)
+    r = np.random.RandomState(2)
+    for _ in range(4):
+        mon.observe((r.randn(64, f) * 4 + 8).astype(np.float32))
+    assert any(rep.kind == "data_drift" for rep in hm.reports)
+    text = reg.prometheus_text()
+    assert "lgbm_drift_reports_total 1" in text
+    assert "lgbm_drift_psi_max" in text
+    # warn-only contract: nothing raised, reports accumulated
+
+
+# -------------------------------------------- profile persistence + b/c
+def test_profile_checkpoint_and_bundle_roundtrip(tmp_path):
+    from lightgbm_tpu.checkpoint import CheckpointManager
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    d = str(tmp_path)
+    _train(dict(_BASE), num_rounds=3, ckpt_dir=d)
+    snap_id, model_path = CheckpointManager(d).latest_model()
+    meta = json.load(open(model_path.replace(".model.txt", ".meta.json")))
+    assert "data_profile" in meta
+    prof = DataProfile.from_json_dict(meta["data_profile"])
+    assert prof is not None and len(prof) == 6 and prof.num_data == 400
+    # stage_file recovers the profile from the sibling meta.json
+    reg = ModelRegistry()
+    bundle = reg.stage_file("m", model_path)
+    assert bundle.profile is not None and len(bundle.profile) == 6
+    # every profiled feature carries its full training quantization
+    fdict = bundle.profile.features[0]
+    assert "mapper" in fdict and sum(fdict["counts"]) == 400
+
+
+def test_pre_profile_snapshot_still_loads(tmp_path):
+    """Back-compat: snapshots written before this layer carry no
+    "data_profile" key — they must load unchanged and the drift surfaces
+    must say "no_profile", never warn or refuse."""
+    from lightgbm_tpu.checkpoint import CheckpointManager
+    from lightgbm_tpu.serving.predictor import ServingEngine
+    d = str(tmp_path)
+    X, y = _data()
+    _train(dict(_BASE), num_rounds=3, ckpt_dir=d, X=X, y=y)
+    _, model_path = CheckpointManager(d).latest_model()
+    meta_path = model_path.replace(".model.txt", ".meta.json")
+    meta = json.load(open(meta_path))
+    del meta["data_profile"]                     # simulate an old snapshot
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh, sort_keys=True)
+    eng = ServingEngine(min_bucket=16, max_batch=64, drift_min_rows=64)
+    bundle = eng.stage_and_prewarm("old", model_path)   # warns, not refuses
+    assert bundle.profile is None
+    eng.registry.register(bundle, replace=True)
+    out = eng.predict("old", X[:32])
+    assert np.isfinite(out).all()
+    st = eng.drift_status()
+    assert st["status"] == "no_profile"
+    assert st["models"]["old"]["status"] == "no_profile"
+    unregister_monitor("old")
+
+
+def test_model_file_without_meta_loads(tmp_path):
+    """A bare model.txt (no sibling meta.json) is the oldest format of
+    all: profile stays None, predictions unaffected."""
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    bst = _train(dict(_BASE, obs_modelstats=False), num_rounds=2)
+    path = str(tmp_path / "bare.model.txt")
+    bst.save_model(path)
+    reg = ModelRegistry()
+    bundle = reg.load_file("bare", path)
+    assert bundle.profile is None
+
+
+# -------------------------------------------------- serving integration
+def test_engine_drift_end_to_end(tmp_path):
+    """Train -> bundle (profile rides along) -> serve shifted traffic ->
+    drift gauges + /healthz-feeding status + on_drift hook."""
+    from lightgbm_tpu.serving.predictor import ServingEngine
+    from lightgbm_tpu.serving.registry import ModelBundle
+    bst = _train(dict(_BASE))
+    eng = ServingEngine(min_bucket=16, max_batch=256, drift_min_rows=128)
+    eng.registry.register(ModelBundle.from_booster("m", bst))
+    fired = []
+    eng.add_drift_hook(fired.append)
+    r = np.random.RandomState(5)
+    for _ in range(4):
+        eng.predict("m", r.randn(64, 6).astype(np.float32))
+    assert eng.drift_status()["status"] == "ok"
+    for _ in range(8):
+        eng.predict("m", (r.randn(64, 6) * 3 + 6).astype(np.float32))
+    st = eng.drift_status()
+    assert st["status"] == "warn" and fired
+    snap = drift_snapshot()
+    assert snap["status"] == "warn" and "m" in snap["models"]
+    unregister_monitor("m")
+
+
+def test_serving_healthz_and_drift_routes(tmp_path):
+    import urllib.request
+    from lightgbm_tpu.serving.predictor import ServingEngine
+    from lightgbm_tpu.serving.registry import ModelBundle
+    from lightgbm_tpu.serving.server import ServingApp, make_server
+    import threading
+    bst = _train(dict(_BASE), num_rounds=3)
+    eng = ServingEngine(drift_min_rows=64)
+    eng.registry.register(ModelBundle.from_booster("m", bst))
+    app = ServingApp(eng)
+    server = make_server(app, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = "http://127.0.0.1:%d" % server.server_address[1]
+        eng.predict("m", np.zeros((16, 6), np.float32))
+        hz = json.load(urllib.request.urlopen(base + "/healthz", timeout=5))
+        assert hz["status"] == "ok"
+        assert hz["drift"] in ("ok", "no_profile")   # warm-up, unshifted
+        dr = json.load(urllib.request.urlopen(base + "/drift", timeout=5))
+        assert "m" in dr["models"]
+        assert dr["models"]["m"]["status"] in ("ok", "no_profile")
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+        unregister_monitor("m")
+
+
+def test_drift_refit_hook_polls_watcher(tmp_path):
+    """arm_drift_refit contract: an ok->warn transition triggers an
+    immediate (async) checkpoint poll — the refit loop's pickup seam."""
+    from lightgbm_tpu.serving.predictor import ServingEngine
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    import time
+    d = str(tmp_path)
+    X, y = _data()
+    _train(dict(_BASE), num_rounds=3, ckpt_dir=d, X=X, y=y)
+    reg = ModelRegistry()
+    eng = ServingEngine(registry=reg, min_bucket=16, max_batch=64,
+                        drift_min_rows=64)
+    w = reg.watch_dir("m", d, engine=eng)        # arms the drift hook
+    assert w.poll() is True
+    polled = []
+    w.poll = lambda: polled.append(1) or False   # count subsequent polls
+    r = np.random.RandomState(6)
+    for _ in range(4):
+        eng.predict("m", (r.randn(64, 6) * 4 + 9).astype(np.float32))
+    deadline = time.time() + 5.0
+    while not polled and time.time() < deadline:
+        time.sleep(0.05)
+    assert polled, "drift warn never triggered the watcher poll"
+    assert eng.drift_status()["status"] == "warn"
+    unregister_monitor("m")
+
+
+# ----------------------------------------------------------- federation
+def test_drift_gauges_federate_across_hosts():
+    """Per-host lgbm_drift_* series merge through the PR 9 Prometheus
+    federation: process-labeled series stay distinct, headers dedupe."""
+    from lightgbm_tpu.obs.distributed import merge_prometheus_texts
+    profile, f = _profile()
+    texts = []
+    for p in range(2):
+        reg = MetricsRegistry()
+        mon = DriftMonitor(profile, model_id="fed", warn_psi=0.25,
+                           min_rows=32, eval_every=32, registry=reg)
+        r = np.random.RandomState(10 + p)
+        shift = 0.0 if p == 0 else 6.0
+        mon.observe((r.randn(64, f) + shift).astype(np.float32))
+        reg.set_global_labels({"process": str(p)})
+        texts.append(reg.prometheus_text())
+    merged = merge_prometheus_texts(texts)
+    assert merged.count("# HELP lgbm_drift_psi_max") == 1
+    for p in range(2):
+        assert ('process="%d"' % p) in merged
+    # the shifted host's psi_max series dominates the healthy host's
+    vals = {}
+    for line in merged.splitlines():
+        if line.startswith("lgbm_drift_psi_max{"):
+            lbl, v = line.rsplit(" ", 1)
+            vals['process="1"' in lbl] = float(v)
+    assert vals[True] > vals[False]
+
+
+# ------------------------------------------------------- metric surface
+def test_modelstats_gauges_and_events(tmp_path):
+    ev_path = str(tmp_path / "events.jsonl")
+    bst = _train(dict(_BASE, tree_growth="frontier", observability="basic",
+                      obs_event_file=ev_path), num_rounds=4)
+    ms = bst._impl._modelstats
+    text = ms._reg.prometheus_text()
+    trees = [l for l in text.splitlines()
+             if l.startswith("lgbm_model_trees ")]
+    assert trees and float(trees[0].split()[-1]) == 4.0
+    assert "lgbm_model_gain_mass" in text
+    assert "lgbm_model_split_count{" in text
+    assert "lgbm_model_leaf_depth" in text
+    kinds = [json.loads(l).get("event") for l in open(ev_path)
+             if l.strip()]
+    assert kinds.count("model_iter") == 4

@@ -305,7 +305,12 @@ def test_http_server_roundtrip():
             assert met["requests"] == 1
             health = json.loads(urllib.request.urlopen(
                 "http://%s:%d/healthz" % (host, port)).read())
-            assert health == {"status": "ok", "models": ["default"]}
+            assert health["status"] == "ok"
+            assert health["models"] == ["default"]
+            assert health["breaker"]["state"] == "closed"
+            # drift is advisory metadata; a bare model file carries
+            # no training profile, so it reports so explicitly
+            assert health["drift"] == "no_profile"
             models = json.loads(urllib.request.urlopen(
                 "http://%s:%d/models" % (host, port)).read())
             assert models["models"][0]["num_features"] == nf

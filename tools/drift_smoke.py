@@ -1,0 +1,178 @@
+"""Train/serve drift end-to-end smoke (tier1 CI).
+
+Exercises the whole model-observability pipe from docs/Observability.md
+("Model statistics & drift") the way an operator would hit it:
+
+1. train a small model with ``obs_modelstats`` on and a checkpoint
+   directory — the training data profile is captured at Dataset
+   construction and persisted into the snapshot's ``meta.json``;
+2. hot-roll the snapshot into a ServingEngine via ``watch_dir`` (the
+   staged bundle recovers the profile from the sibling meta file) and
+   bind the serving HTTP front-end;
+3. serve same-distribution traffic and assert the drift status stays
+   ``ok``, then serve SHIFTED traffic and assert, within a bounded
+   number of batches:
+   - the ``lgbm_drift_psi`` / ``lgbm_drift_psi_max`` gauges cross the
+     warn threshold (scraped over ``/metrics/prometheus``),
+   - ``/healthz`` reports ``drift: warn`` while staying HTTP 200 (drift
+     is advisory — it must never shed traffic),
+   - ``/drift`` carries the per-feature PSI detail,
+   - the ``on_drift`` refit hook fired exactly once (edge-triggered);
+4. verify the training-side surfaces: ``feature_importance`` parity
+   against the streamed accumulator and the ``lgbm_model_*`` gauges.
+
+Exit code 0 = every assertion holds. The summary JSON goes to ``--out``
+(and stdout) for the CI artifact.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # repo root for lightgbm_tpu
+
+
+def _get(base: str, path: str) -> bytes:
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return r.read()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default="drift_smoke_out",
+                    help="checkpoints land here")
+    ap.add_argument("--out", default="", help="write the summary JSON here")
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--warn-psi", type=float, default=0.25)
+    args = ap.parse_args()
+    os.makedirs(args.workdir, exist_ok=True)
+    ckpt_dir = os.path.join(args.workdir, "ckpt")
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import callback, engine
+    from lightgbm_tpu.serving.predictor import ServingEngine
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    from lightgbm_tpu.serving.server import ServingApp, make_server
+
+    failures = []
+
+    def check(cond, msg):
+        (failures.append(msg) if not cond else None)
+        print("%s %s" % ("ok  " if cond else "FAIL", msg))
+
+    # ---- 1. train with modelstats + checkpointing ----------------------
+    r = np.random.RandomState(0)
+    n, f = 2000, 8
+    X = r.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * r.randn(n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+              "tree_growth": "frontier", "obs_modelstats": True,
+              "obs_drift_warn_psi": args.warn_psi}
+    bst = engine.train(params, lgb.Dataset(X, label=y),
+                       num_boost_round=args.iters,
+                       callbacks=[callback.checkpoint(ckpt_dir, period=1)])
+
+    ms = bst._impl._modelstats
+    check(ms is not None and ms.trees == args.iters,
+          "modelstats tracked %d trees" % args.iters)
+    imp_stream = ms.importance("split")
+    imp_host = bst.feature_importance("split").astype(np.float64)
+    check(np.array_equal(imp_stream, imp_host),
+          "streaming split importance == host recomputation")
+    check(np.allclose(ms.importance("gain"), bst.feature_importance("gain"),
+                      rtol=1e-3, atol=1e-2),
+          "streaming gain importance ~ host recomputation")
+
+    # ---- 2. hot-roll the snapshot into a serving engine ----------------
+    reg = ModelRegistry()
+    eng = ServingEngine(registry=reg, min_bucket=16, max_batch=128,
+                        drift_warn_psi=args.warn_psi, drift_min_rows=128)
+    watcher = reg.watch_dir("m", ckpt_dir, engine=eng)   # arms drift hook
+    check(watcher.poll() is True, "snapshot hot-rolled into the registry")
+    bundle = reg.get("m")
+    check(bundle.profile is not None and len(bundle.profile) == f,
+          "staged bundle recovered the %d-feature training profile" % f)
+
+    refits = []
+    eng.add_drift_hook(refits.append)
+
+    app = ServingApp(eng)
+    server = make_server(app, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = "http://127.0.0.1:%d" % server.server_address[1]
+    summary = {}
+    try:
+        # ---- 3a. same-distribution traffic stays ok --------------------
+        for _ in range(4):
+            eng.predict("m", r.randn(64, f).astype(np.float32))
+        hz = json.loads(_get(base, "/healthz"))
+        check(hz.get("drift") == "ok",
+              "/healthz drift=ok on same-distribution traffic (got %r)"
+              % hz.get("drift"))
+
+        # ---- 3b. shifted traffic warns within bounded batches ----------
+        batches = 0
+        for batches in range(1, 13):
+            eng.predict("m",
+                        (r.randn(64, f) * 3 + 6).astype(np.float32))
+            if eng.drift_status()["status"] == "warn":
+                break
+        check(eng.drift_status()["status"] == "warn",
+              "drift warn within %d shifted batches" % batches)
+
+        hz = json.loads(_get(base, "/healthz"))
+        check(hz.get("drift") == "warn", "/healthz reports drift: warn")
+        check(hz.get("status") == "ok",
+              "drift is advisory: /healthz stays HTTP-200 ok")
+
+        drift = json.loads(_get(base, "/drift"))
+        mstat = drift.get("models", {}).get("m", {})
+        check(drift.get("status") == "warn" and
+              mstat.get("max_psi", 0) >= args.warn_psi,
+              "/drift carries max_psi >= %.2f" % args.warn_psi)
+        check(any(v.get("psi", 0) >= args.warn_psi
+                  for v in mstat.get("features", {}).values()),
+              "/drift carries per-feature PSI detail")
+
+        prom = _get(base, "/metrics/prometheus").decode()
+        psi_lines = [l for l in prom.splitlines()
+                     if l.startswith("lgbm_drift_psi_max{")]
+        check(psi_lines and max(float(l.rsplit(" ", 1)[1])
+                                for l in psi_lines) >= args.warn_psi,
+              "lgbm_drift_psi_max gauge crossed the threshold")
+        check("lgbm_drift_psi{" in prom,
+              "per-feature lgbm_drift_psi gauges exported")
+        check("lgbm_model_trees" in prom,
+              "training-side lgbm_model_* gauges share the registry")
+        check(len(refits) == 1,
+              "on_drift refit hook fired exactly once (got %d)"
+              % len(refits))
+        check("lgbm_drift_reports_total 1" in prom,
+              "drift report routed through the health monitor")
+
+        summary = {"iterations": args.iters,
+                   "shifted_batches_to_warn": batches,
+                   "max_psi": mstat.get("max_psi"),
+                   "healthz": hz,
+                   "refit_hook_fires": len(refits),
+                   "split_importance": [int(v) for v in imp_host]}
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+
+    summary["failures"] = failures
+    blob = json.dumps(summary, indent=2, sort_keys=True)
+    print(blob)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(blob + "\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
