@@ -125,6 +125,22 @@ def collect_audit(workload: Optional[Dict[str, Any]] = None
         entries["grower_sharded"] = jaxpr_audit.audit_jaxpr(
             jax.make_jaxpr(sfn)(*sargs))
 
+    # ---- parallel-learner wave schedules (parallel/learners.py): the
+    # reduce-scatter data learner and the PV-Tree voting learner on the
+    # same 8-device mesh, feature axis widened to 16 so the psum_scatter
+    # tiles evenly (8 | F). These PIN the comm-volume win statically:
+    # data_rs exchanges F*B*3/P + P*RECORD_LANES floats per wave where the
+    # serial schedule psums F*B*3; voting exchanges only the 2*top_k
+    # elected columns (+ two int32 vote gathers).
+    for nm, overrides in (("grower_sharded_data", {"frontier_rs": True}),
+                          ("grower_sharded_voting", {"voting_top_k": 2})):
+        sharded = jaxpr_audit.sharded_frontier_fn(
+            param_overrides=overrides, num_features=16)
+        if sharded is not None:
+            sfn, sargs, _ = sharded
+            entries[nm] = jaxpr_audit.audit_jaxpr(
+                jax.make_jaxpr(sfn)(*sargs))
+
     # ---- serving predict buckets (traced, never compiled)
     from ..serving.predictor import ServingEngine, bucket_sizes
     from ..serving.registry import ModelRegistry

@@ -157,7 +157,8 @@ def audit_jaxpr(jaxpr) -> Dict[str, Any]:
 
 # ------------------------------------------------------------ shared entry
 def sharded_frontier_fn(num_devices: int = 8,
-                        param_overrides: Optional[Dict[str, Any]] = None):
+                        param_overrides: Optional[Dict[str, Any]] = None,
+                        num_features: int = 4):
     """The canonical sharded frontier-grower entry: ``(fn, args,
     params)`` such that ``jax.make_jaxpr(fn)(*args)`` is the
     8-virtual-device shard_map program whose per-wave psum count
@@ -165,6 +166,9 @@ def sharded_frontier_fn(num_devices: int = 8,
     tests/test_obs.py pins.  One construction, three consumers.
     ``param_overrides`` lets invariance tests toggle GrowParams fields
     (``obs_health``) on the otherwise-identical program.
+    ``num_features`` widens the feature axis (default 4, the historical
+    shape — baselines keyed on it must not drift); the reduce-scatter
+    learner needs it divisible by ``num_devices``.
 
     Returns None when fewer than ``num_devices`` devices exist (the
     analyze/perf-gate CLIs re-exec with a virtual-device flag to
@@ -182,7 +186,7 @@ def sharded_frontier_fn(num_devices: int = 8,
     from ..core.split import FeatureMeta, SplitParams
 
     r = np.random.RandomState(0)
-    n, f, b = 256, 4, 16
+    n, f, b = 256, int(num_features), 16
     xb = r.randint(0, b, (n, f)).astype(np.uint8)
     g = r.randn(n).astype(np.float32)
     ones = np.ones(n, np.float32)

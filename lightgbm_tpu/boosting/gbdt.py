@@ -23,6 +23,7 @@ Key mappings:
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -261,6 +262,7 @@ class GBDT:
         self._use_input_grads = False
         self.mesh = None
         self._row_valid = None
+        self._frontier_rs = False
         # observability facade (lightgbm_tpu.obs): replaced by the
         # config-driven one in _setup_train; loaded/predict-only boosters
         # keep the disabled no-op
@@ -299,6 +301,17 @@ class GBDT:
             # num_bin=1 metadata which the split search treats as unusable
             fsize = (self.mesh.shape[mesh_mod.FEATURE_AXIS]
                      if mesh_mod.FEATURE_AXIS in self.mesh.axis_names else 1)
+            # frontier data-parallel reduce-scatter (parallel/learners.py
+            # DataRSLearner): the per-wave psum_scatter tiles the feature
+            # axis over the DATA axis, so columns must also divide dsize
+            self._frontier_rs = (
+                cfg.tree_growth == "frontier"
+                and cfg.tree_learner == "data"
+                and mesh_mod.DATA_AXIS in self.mesh.axis_names
+                and bool(cfg.tpu_frontier_rs)
+                and _hist_dtype(cfg) != "f64")
+            if self._frontier_rs:
+                fsize = fsize * dsize // math.gcd(fsize, dsize)
             fpad = (-xb_np.shape[1]) % fsize
             if fpad:
                 xb_np = np.concatenate(
@@ -381,10 +394,17 @@ class GBDT:
                 raise LightGBMError(
                     mode + " requires exact split ordering; disable forced "
                     "splits / CEGB or use tree_growth=exact")
-            if cfg.tree_learner in ("voting", "feature"):
+            # the frontier wave grower carries the voting-parallel election
+            # (parallel/learners.py VotingLearner); batched growth and the
+            # explicit feature-parallel learner still need exact ordering /
+            # the grow_tree fp context
+            if cfg.tree_learner == "feature" or (
+                    cfg.tree_learner == "voting"
+                    and cfg.tree_growth != "frontier"):
                 raise LightGBMError(
-                    mode + " supports the serial and data tree learners "
-                    "only (got tree_learner=%s)" % cfg.tree_learner)
+                    mode + " does not support tree_learner=%s (serial and "
+                    "data always work; voting needs tree_growth=frontier)"
+                    % cfg.tree_learner)
             if _hist_dtype(cfg) == "f64":
                 # both wave growers accumulate f32 (slot kernel layout);
                 # silently downgrading would betray the dp promise
@@ -492,6 +512,11 @@ class GBDT:
             batched_pack=(batch_splits > 0 and cfg.tpu_batched_pack),
             batched_part=batched_part,
             frontier_mode=frontier_mode,
+            # reduce-scatter wave histograms (DataRSLearner): resolved at
+            # padding time — needs frontier + data learner + a data axis +
+            # tpu_frontier_rs + f32 histograms (and columns padded to the
+            # axis size, which _frontier_rs guaranteed above)
+            frontier_rs=(frontier_mode and self._frontier_rs),
             # wave-width bucketing: off under vmapped multiclass growth —
             # vmap lowers the width switch to execute-ALL-branches, which
             # costs ~2x the fixed-width wave instead of saving it

@@ -70,7 +70,13 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("cat_l2", float, 10.0, []),
     ("cat_smooth", float, 10.0, []),
     ("max_cat_to_onehot", int, 4, []),
-    # voting parallel (config.h:349)
+    # voting-parallel candidate count (config.h:349 top_k; PV-Tree,
+    # voting_parallel_tree_learner.cpp): with tree_learner=voting each
+    # device nominates its local top_k features per frontier slot and
+    # only the <= 2*top_k vote-elected features' histogram columns are
+    # exchanged per wave — comm O(2*top_k*B) instead of O(F*B). Larger is
+    # more accurate (top_k >= num_features degenerates to the exact
+    # data-parallel search), smaller is cheaper. Must be >= 1.
     ("top_k", int, 20, ["topk"]),
     ("monotone_constraints", list, [], ["mc", "monotone_constraint"]),
     ("feature_contri", list, [], ["feature_contrib", "fc", "fp", "feature_penalty"]),
@@ -189,6 +195,15 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # payload track 2^depth on early waves, structure unchanged. false
     # pins every wave at the fixed maximum width (debug / A-B runs).
     ("tpu_frontier_bucketing", bool, True, ["frontier_bucketing"]),
+    # frontier data-parallel reduce-scatter schedule (parallel/learners.py
+    # DataRSLearner): replace the per-wave full-histogram psum with a
+    # tiled psum_scatter over the feature axis + a small all_gather/argmax
+    # election of packed best-split records — per-device wave comm and
+    # hist-pool memory drop to ~1/P. Committed trees are identical to the
+    # psum schedule (contiguous rank-ordered feature blocks preserve the
+    # first-max tie-break). false restores the full-psum wave (debug /
+    # A-B runs). Only applies to tree_learner=data + tree_growth=frontier.
+    ("tpu_frontier_rs", bool, True, ["frontier_rs"]),
     # persistent XLA compilation cache (jax_compilation_cache_dir):
     # compiled executables are written here and reloaded by later
     # processes, so warm starts skip backend compilation entirely —
@@ -525,6 +540,9 @@ class Config:
         if self.tpu_row_chunk < 0:
             raise LightGBMError("tpu_row_chunk should be >= 0 (0 = auto), "
                                 "got %s" % self.tpu_row_chunk)
+        if self.top_k < 1:
+            raise LightGBMError("top_k should be >= 1 (voting-parallel "
+                                "candidate count), got %s" % self.top_k)
         # a file where the cache DIRECTORY should be will corrupt silently
         # deep inside jax; fail at config time like the other path params
         if self.compile_cache_dir:

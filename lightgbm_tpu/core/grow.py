@@ -140,6 +140,14 @@ class GrowParams(NamedTuple):
     # stay off under vmapped_classes — vmap lowers switch to
     # execute-all-branches, which would cost MORE than fixed width.
     frontier_bucketing: bool = False
+    # frontier data-parallel reduce-scatter schedule (parallel/learners.py
+    # DataRSLearner, data_parallel_tree_learner.cpp:146-161): the per-wave
+    # histogram psum becomes a tiled psum_scatter over the feature axis,
+    # each device scans only its contiguous feature block, and one small
+    # all_gather of packed best-split records elects the global winners.
+    # Requires stored columns divisible by the mesh axis size (the GBDT
+    # driver pads) and no EFB. False = the PR 2 full-psum schedule.
+    frontier_rs: bool = False
     # observability health piggy-back (lightgbm_tpu.obs): the frontier
     # wave loop threads a 2-scalar (waves executed, nonfinite committed
     # gain) accumulator through its carry and returns it in the aux slot.

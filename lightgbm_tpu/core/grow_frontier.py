@@ -70,6 +70,7 @@ from jax import lax
 from ..bucketing import frontier_max_width, wave_width_ladder
 from ..compat import pcast
 from ..obs.modelstats import init_mstats, update_mstats
+from ..parallel.learners import make_frontier_learner
 from .histogram import build_histogram, build_histogram_frontier
 from .grow import (GrowParams, TreeArrays, _bin_go_left, _empty_best,
                    decode_bundle_value, empty_tree, expand_hist)
@@ -188,14 +189,22 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
             min_constraint=min_c, max_constraint=max_c,
             with_categorical=params.with_categorical)
 
+    # wave-collective schedule (parallel/learners.py): serial emits the
+    # psum/child_best closures verbatim; data_rs reduce-scatters histograms
+    # over the feature axis and elects packed best records; voting keeps
+    # histograms local and exchanges only vote-elected columns
+    lrn = make_frontier_learner(params, axis_name, meta, feature_mask,
+                                psum, child_best)
+
     # ---- root (identical to exact mode) ---------------------------------
     sample_mask = sample_mask.astype(jnp.float32)
     root_g = psum(jnp.sum(grad * sample_mask))
     root_h = psum(jnp.sum(hess * sample_mask))
     root_c = psum(jnp.sum(sample_mask))
-    hist_root = psum(build_histogram(xb, grad, hess, sample_mask, num_bins=b,
-                                     row_chunk=params.row_chunk,
-                                     impl=params.hist_impl))
+    hist_root = lrn.reduce(build_histogram(xb, grad, hess, sample_mask,
+                                           num_bins=b,
+                                           row_chunk=params.row_chunk,
+                                           impl=params.hist_impl))
     tree = empty_tree(l)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(
@@ -203,13 +212,20 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
                                   sp.max_delta_step)),
         leaf_weight=tree.leaf_weight.at[0].set(root_h),
         leaf_count=tree.leaf_count.at[0].set(root_c))
-    best0 = child_best(hist_root, root_g, root_h, root_c, -jnp.inf, jnp.inf)
+    best0 = lrn.best_root(hist_root, root_g, root_h, root_c)
     best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
 
     # per-leaf histogram pool: a frontier leaf's histogram survives from
     # the wave that created it, so the subtraction trick works wave-wide
-    # (parent - smaller child = larger child; histogram.cpp:xx Subtract)
-    hist_pool = jnp.zeros((l, ncols, b, 3), jnp.float32).at[0].set(hist_root)
+    # (parent - smaller child = larger child; histogram.cpp:xx Subtract).
+    # Shape follows the learner's reduced histogram: full [C, B, 3] on the
+    # serial/voting schedules, the device's feature shard under data_rs
+    hist_pool = jnp.zeros((l,) + hist_root.shape, jnp.float32)
+    if lrn.varying_pool:
+        # the pool holds device-varying content (local histograms under
+        # voting, per-device feature shards under data_rs)
+        hist_pool = pcast(hist_pool, (axis_name,), to="varying")
+    hist_pool = hist_pool.at[0].set(hist_root)
 
     leaf_id0 = jnp.zeros((n,), jnp.int32)
     if axis_name is not None:
@@ -273,7 +289,7 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         left_small = cur.left_count <= cur.right_count       # [kw]
         in_small = active & (go_left == left_small[rs])
         slot = jnp.where(in_small, rs, -1)
-        hist_small = psum(build_histogram_frontier(
+        hist_small = lrn.reduce(build_histogram_frontier(
             xb, slot, grad, hess, sample_mask, num_bins=b, num_slots=kw,
             row_chunk=params.row_chunk,
             impl=params.hist_impl))                # [kw, C, B, 3]
@@ -300,12 +316,12 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
 
         # ---- best splits for all 2K children, one vmapped search --------
         ch_hist = jnp.stack([hist_left, hist_right],
-                            axis=1).reshape(2 * kw, ncols, b, 3)
+                            axis=1).reshape((2 * kw,) + hist_left.shape[1:])
         ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
         ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
         ch_cnt = interleave_lr(cur.left_count, cur.right_count)
-        b2k = jax.vmap(child_best)(ch_hist, ch_sg, ch_sh, ch_cnt,
-                                   ch_min, ch_max)
+        b2k = lrn.best_children(ch_hist, ch_sg, ch_sh, ch_cnt,
+                                ch_min, ch_max)
         b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
         best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
 

@@ -92,6 +92,63 @@ def _psum_per_wave(param_overrides: Optional[Dict[str, Any]] = None
     return float(total) / max(waves, 1)
 
 
+def _wave_collectives(param_overrides: Optional[Dict[str, Any]] = None,
+                      num_features: int = 16,
+                      num_devices: int = 8
+                      ) -> Optional[Tuple[float, float]]:
+    """(collective op count, received f32 payload elements) of ONE growth
+    wave of the sharded frontier grower — the static comm-volume contract
+    of each parallel learner (parallel/learners.py). The growth loop is
+    the only ``while`` whose body holds collectives (the hist chunk loops
+    have none), so its body's schedule IS the per-wave schedule. Payload
+    counts f32 elements RECEIVED per device: psum = operand size,
+    reduce_scatter = operand / P, all_gather = P * operand. int32 vote
+    traffic is excluded (it is negligible by design and the op count pins
+    it). None when fewer than ``num_devices`` devices exist."""
+    import numpy as np
+
+    import jax
+
+    from ..analysis import jaxpr_audit
+
+    entry = jaxpr_audit.sharded_frontier_fn(param_overrides=param_overrides,
+                                            num_features=num_features)
+    if entry is None:
+        return None
+    fn, args, _ = entry
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    wave_body = None
+    for eqn in jaxpr_audit.iter_eqns(jaxpr):
+        if eqn.primitive.name != "while":
+            continue
+        for sub in jaxpr_audit._sub_jaxprs(eqn):
+            if any(e.primitive.name in jaxpr_audit.COLLECTIVE_PRIMITIVES
+                   for e in jaxpr_audit.iter_eqns(sub)):
+                wave_body = sub
+                break
+        if wave_body is not None:
+            break
+    if wave_body is None:
+        return 0.0, 0.0
+    ops = 0
+    payload = 0.0
+    for e in jaxpr_audit.iter_eqns(wave_body):
+        if e.primitive.name not in jaxpr_audit.COLLECTIVE_PRIMITIVES:
+            continue
+        ops += 1
+        aval = e.invars[0].aval
+        if str(getattr(aval, "dtype", "")) != "float32":
+            continue
+        elems = float(np.prod(aval.shape)) if aval.shape else 1.0
+        if e.primitive.name in ("reduce_scatter", "psum_scatter"):
+            payload += elems / num_devices
+        elif e.primitive.name == "all_gather":
+            payload += elems * num_devices
+        else:
+            payload += elems
+    return float(ops), payload
+
+
 def bucketing_ladder(num_leaves: int, max_depth: int) -> List[int]:
     from .. import bucketing
     return [int(w) for w in bucketing.wave_width_ladder(num_leaves,
@@ -173,6 +230,20 @@ def measure(workload: Optional[Dict[str, Any]] = None
     psum_obs = _psum_per_wave(param_overrides={"obs_health": True})
     if psum_obs is not None:
         counters["psum_per_wave_branch_obs"] = psum_obs
+    # per-wave collective schedule of each parallel learner (16-feature
+    # variant so the data learner's psum_scatter tiles over 8 devices):
+    # op count + f32 elements RECEIVED per device per wave. These pin the
+    # comm-volume win statically — voting's wave payload is the 2*top_k
+    # elected columns per slot (here 4 of 16 features), data_rs is the
+    # 1/P histogram shard plus the packed record gather, serial is the
+    # full F*B*3 psum.
+    for suffix, overrides in (("serial", None),
+                              ("data_rs", {"frontier_rs": True}),
+                              ("voting", {"voting_top_k": 2})):
+        wave = _wave_collectives(param_overrides=overrides)
+        if wave is not None:
+            counters["wave_collectives_" + suffix] = wave[0]
+            counters["wave_payload_f32_" + suffix] = wave[1]
     return counters, wl
 
 

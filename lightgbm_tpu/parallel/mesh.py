@@ -3,17 +3,19 @@
 The reference's distribution model (tree_learner=serial/feature/data/voting ×
 num_machines, config.h:177,748) maps onto a jax.sharding.Mesh:
 
-- ``data`` axis: rows sharded (DataParallelTreeLearner analog). Histograms
-  built from row shards are combined by XLA-inserted all-reduces under GSPMD
-  (the ReduceScatter of data_parallel_tree_learner.cpp:146-161 becomes a
-  compiler-inserted collective).
+- ``data`` axis: rows sharded (DataParallelTreeLearner analog). The exact
+  grower psums histograms under an explicit shard_map; the frontier grower
+  selects its wave-collective schedule from ``parallel/learners.py`` —
+  full psum (serial schedule), tiled reduce-scatter + best-record election
+  (``tree_learner=data``, data_parallel_tree_learner.cpp:146-161), or the
+  PV-Tree vote (``tree_learner=voting``).
 - ``feature`` axis: feature columns sharded (FeatureParallelTreeLearner
   analog); per-feature split search shards naturally, the global argmax is
   the SyncUpGlobalBestSplit (parallel_tree_learner.h:186) analog.
-- voting-parallel uses the explicit shard_map path (learners.py) because its
-  comm compression (top-k vote, then reduce only elected features,
-  voting_parallel_tree_learner.cpp:166-360) is a manual optimization GSPMD
-  cannot infer.
+- voting-parallel uses the explicit shard_map path (learners.py
+  VotingLearner) because its comm compression (top-k vote, then reduce only
+  elected features, voting_parallel_tree_learner.cpp:166-360) is a manual
+  optimization GSPMD cannot infer.
 """
 from __future__ import annotations
 
@@ -30,11 +32,26 @@ DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
 
+_warned_fallback = False
+
+
+def _warn_serial_fallback(reason: str) -> None:
+    """One-time loud notice that a parallel tree_learner is running the
+    serial schedule — a silent fallback here cost users real scaling runs
+    (the config LOOKS distributed but every collective is a no-op)."""
+    global _warned_fallback
+    if not _warned_fallback:
+        _warned_fallback = True
+        Log.warning("tree_learner falls back to serial: " + reason)
+
+
 def build_mesh(config: Config, devices=None) -> Optional[Mesh]:
     """Build the training mesh from config (mesh_shape / tree_learner).
 
     Returns None for single-device serial training (the common case on one
-    chip) — everything then runs unsharded.
+    chip) — everything then runs unsharded. When a parallel tree_learner
+    was requested but no mesh can be built, the fallback is announced once
+    via Log.warning (never silently).
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
@@ -55,6 +72,14 @@ def build_mesh(config: Config, devices=None) -> Optional[Mesh]:
         axis = (FEATURE_AXIS if config.tree_learner == "feature"
                 else DATA_AXIS)
         return Mesh(np.asarray(devices), (axis,))
+    if config.tree_learner != "serial":
+        _warn_serial_fallback(
+            "tree_learner=%s requested but only %d device is visible and "
+            "no mesh_shape was given (single-process runs need "
+            "mesh_shape=[P] over virtual/local devices; multi-process runs "
+            "need num_machines>1 with machines/local_listen_port so "
+            "jax.distributed exposes every process's devices)"
+            % (config.tree_learner, n))
     return None
 
 

@@ -34,6 +34,17 @@ def init(machines: str = "", local_listen_port: int = 12400,
         _initialized = True
         return
     import jax
+    # Compiled collectives on the CPU backend need a cross-process
+    # implementation: jax's default leaves psum/all_gather unable to cross
+    # process boundaries, which would break every learner schedule in
+    # parallel/learners.py the moment the mesh spans hosts. Gloo rides the
+    # same TCP fabric the coordinator already uses; TPU/GPU backends ignore
+    # the flag. Must be set before the first backend client is created —
+    # if the caller already touched jax.devices(), leave their choice alone.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jaxlib without gloo, or backend already up
+        pass
     hosts: List[str] = [m.strip() for m in machines.split(",") if m.strip()]
     if len(hosts) != num_machines:
         raise LightGBMError(
@@ -261,6 +272,31 @@ class KvHostComm(HostComm):
             except Exception:
                 pass
         return out
+
+
+def check_model_agreement(digest: str, comm: Optional["HostComm"] = None,
+                          namespace: str = "lgbm_model_agree") -> List[str]:
+    """Cross-process model-agreement check: allgather each rank's model
+    digest and fail loudly if any pair differs.
+
+    Data-parallel training is replicated-by-construction — every rank
+    commits the tree built from the globally reduced histograms — so a
+    digest mismatch always means real divergence (non-deterministic input
+    order, a rank reading different data, a collective silently local).
+    Returns the rank-ordered digest list; raises LightGBMError naming the
+    disagreeing ranks. Single-process runs return ``[digest]`` untouched.
+    """
+    if comm is None:
+        comm = default_host_comm(namespace=namespace)
+    if comm is None:
+        return [str(digest)]
+    digests = [str(d) for d in comm.allgather(str(digest))]
+    if len(set(digests)) > 1:
+        raise LightGBMError(
+            "model disagreement across processes: "
+            + ", ".join("rank %d=%s" % (i, d[:16])
+                        for i, d in enumerate(digests)))
+    return digests
 
 
 def default_host_comm(namespace: str = "lgbm_hostcomm",

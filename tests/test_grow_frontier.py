@@ -162,8 +162,14 @@ def test_frontier_refuses_order_dependent_features():
                       "verbosity": -1,
                       "cegb_penalty_feature_coupled": [0.1] * X.shape[1],
                       "cegb_tradeoff": 1.0}, rounds=1)
+    # the explicit feature-parallel learner needs grow_tree's fp context
     with pytest.raises(LightGBMError, match="frontier"):
         _train(X, y, {"objective": "binary", "tree_growth": "frontier",
+                      "tree_learner": "feature", "verbosity": -1}, rounds=1)
+    # voting rides the frontier waves now (parallel/learners.py) but still
+    # refuses batched growth, whose commit loop has no election seam
+    with pytest.raises(LightGBMError, match="voting"):
+        _train(X, y, {"objective": "binary", "tree_growth": "batched",
                       "tree_learner": "voting", "verbosity": -1}, rounds=1)
 
 

@@ -1,0 +1,234 @@
+"""Frontier parallel learners (parallel/learners.py, tree_learner=
+serial|data|voting on tree_growth=frontier).
+
+Contract being pinned:
+- the data-parallel reduce-scatter schedule (DataRSLearner) and the
+  full-psum schedule commit IDENTICAL trees — the packed best-record
+  election preserves find_best_split's first-max tie-break because
+  feature blocks are contiguous in rank order;
+- voting with top_k >= F elects every feature and degenerates to the
+  exact data-parallel search (structure-identical to serial);
+- voting with a small top_k is a DOCUMENTED approximation: training
+  still converges, with train loss monotone and near serial's;
+- shard skew (sorted rows, uneven remainders) must not change the
+  committed structure — histograms are summed across the mesh before
+  any decision;
+- unsupported combos refuse loudly or warn once, never silently serial.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.log import LightGBMError, Log
+
+from conftest import make_binary
+from test_grow_frontier import _canonical_splits, _golden_data, _train
+
+
+def _mesh8(extra=None):
+    base = {"objective": "binary", "num_leaves": 64, "max_depth": 4,
+            "min_data_in_leaf": 40, "verbosity": -1,
+            "tree_growth": "frontier"}
+    base.update(extra or {})
+    return base
+
+
+# ------------------------------------------------------------ fast units
+def test_best_record_pack_roundtrip():
+    """Every BestSplit field survives the f32-lane packing bitwise —
+    including negative thresholds, bools, and high-bit bitset words
+    (a value-cast would corrupt those)."""
+    from lightgbm_tpu.core.split import BestSplit
+    from lightgbm_tpu.parallel.learners import (RECORD_LANES,
+                                                pack_best_record,
+                                                unpack_best_record)
+    k = 3
+    bs = BestSplit(
+        gain=jnp.asarray([1.5, -jnp.inf, 0.0], jnp.float32),
+        feature=jnp.asarray([7, 0, 2 ** 30], jnp.int32),
+        threshold=jnp.asarray([-1, 255, 3], jnp.int32),
+        default_left=jnp.asarray([True, False, True]),
+        left_sum_grad=jnp.asarray([0.1, -2.0, 3.0], jnp.float32),
+        left_sum_hess=jnp.asarray([1.0, 2.0, 3.0], jnp.float32),
+        left_count=jnp.asarray([10.0, 0.0, 5.0], jnp.float32),
+        right_sum_grad=jnp.asarray([-0.1, 2.0, -3.0], jnp.float32),
+        right_sum_hess=jnp.asarray([9.0, 8.0, 7.0], jnp.float32),
+        right_count=jnp.asarray([1.0, 2.0, 3.0], jnp.float32),
+        left_output=jnp.asarray([0.5, -0.5, 0.0], jnp.float32),
+        right_output=jnp.asarray([-0.5, 0.5, 1.0], jnp.float32),
+        is_categorical=jnp.asarray([False, True, False]),
+        cat_bitset=jnp.asarray(
+            np.array([[0xFFFFFFFF] * 8, [0] * 8, [0x80000001] * 8],
+                     np.uint32)))
+    rec = pack_best_record(bs)
+    assert rec.shape == (k, RECORD_LANES)
+    out = unpack_best_record(rec)
+    for a, b in zip(bs, out):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_k_validates():
+    with pytest.raises(LightGBMError, match="top_k"):
+        Config({"top_k": 0})
+    assert Config({"topk": 5}).top_k == 5
+
+
+def test_unknown_tree_learner_raises():
+    with pytest.raises(LightGBMError, match="tree learner"):
+        Config({"tree_learner": "gossip"})
+
+
+def test_check_model_agreement_loopback():
+    """The smoke's cross-rank digest check: identical digests pass in
+    rank order, a diverged rank fails EVERY rank loudly (naming ranks) —
+    a silent majority-wins would hide real replication bugs."""
+    import threading
+    from lightgbm_tpu.parallel.network import (LoopbackComm,
+                                               check_model_agreement)
+
+    def run(digests):
+        comms = LoopbackComm.group(len(digests), timeout_s=10)
+        out = [None] * len(digests)
+
+        def worker(r):
+            try:
+                out[r] = check_model_agreement(digests[r], comm=comms[r])
+            except Exception as e:  # noqa: BLE001 - asserted below
+                out[r] = e
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(len(digests))]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        return out
+
+    ok = run(["abc123", "abc123"])
+    assert ok == [["abc123", "abc123"]] * 2
+    bad = run(["abc123", "def456"])
+    for e in bad:
+        assert isinstance(e, LightGBMError)
+        assert "rank 0" in str(e) and "rank 1" in str(e)
+    # single process (no comm, no cluster): pass-through
+    assert check_model_agreement("solo") == ["solo"]
+
+
+def test_single_device_fallback_warns_once():
+    """A parallel tree_learner that cannot build a mesh must say so —
+    the silent-serial fallback cost users real scaling runs."""
+    from lightgbm_tpu.parallel import mesh as mesh_mod
+    msgs = []
+    mesh_mod._warned_fallback = False
+    Log.reset_callback(msgs.append)
+    try:
+        m = mesh_mod.build_mesh(Config({"tree_learner": "voting",
+                                        "verbosity": 0}),
+                                devices=jax.devices()[:1])
+        assert m is None
+        m = mesh_mod.build_mesh(Config({"tree_learner": "data",
+                                        "verbosity": 0}),
+                                devices=jax.devices()[:1])
+        assert m is None
+    finally:
+        Log.reset_callback(None)
+        mesh_mod._warned_fallback = False
+    warned = [m for m in msgs if "falls back to serial" in m]
+    assert len(warned) == 1            # one-time, not once per build
+    assert "voting" in warned[0]
+
+
+# ------------------------------------------------- structure identity (mesh)
+@pytest.mark.slow
+def test_data_rs_matches_serial_and_psum_path():
+    """The reduce-scatter schedule commits the same trees as both the
+    single-device grower and the full-psum mesh schedule
+    (tpu_frontier_rs=false A/B) on the tie-free golden config."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    X, y = _golden_data()
+    b1 = _train(X, y, _mesh8(), rounds=5)
+    brs = _train(X, y, _mesh8({"tree_learner": "data", "mesh_shape": [8]}),
+                 rounds=5)
+    bps = _train(X, y, _mesh8({"tree_learner": "data", "mesh_shape": [8],
+                               "tpu_frontier_rs": False}), rounds=5)
+    assert _canonical_splits(b1, num=5) == _canonical_splits(brs, num=5)
+    assert _canonical_splits(bps, num=5) == _canonical_splits(brs, num=5)
+    p1 = b1.predict(X[:200], raw_score=True)
+    prs = brs.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(p1, prs, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_voting_topk_full_degenerates_to_data_parallel():
+    """top_k >= F elects every feature: the voting learner's candidate
+    histogram equals the full global histogram and the committed
+    structure matches serial exactly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    X, y = _golden_data()
+    b1 = _train(X, y, _mesh8(), rounds=5)
+    bv = _train(X, y, _mesh8({"tree_learner": "voting", "mesh_shape": [8],
+                              "top_k": X.shape[1]}), rounds=5)
+    assert _canonical_splits(b1, num=5) == _canonical_splits(bv, num=5)
+    p1 = b1.predict(X[:200], raw_score=True)
+    pv = bv.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(p1, pv, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_data_rs_skewed_shards():
+    """Rows sorted by label: every shard sees a wildly different class
+    mix (the 600-row golden set also leaves the last shard short after
+    padding). Histograms are reduced before any decision, so the
+    committed structure must still match single-device."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    X, y = _golden_data()
+    order = np.argsort(y, kind="stable")
+    X, y = X[order], y[order]
+    b1 = _train(X, y, _mesh8(), rounds=5)
+    b8 = _train(X, y, _mesh8({"tree_learner": "data", "mesh_shape": [8]}),
+                rounds=5)
+    assert _canonical_splits(b1, num=5) == _canonical_splits(b8, num=5)
+
+
+@pytest.mark.slow
+def test_voting_small_topk_documented_approximation():
+    """PV-Tree with a small top_k is approximate: candidates can miss
+    the global best feature. The documented contract (docs/
+    Distributed.md): training still converges — train logloss decreases
+    monotonically and lands within tolerance of serial's."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    X, y = make_binary(n=2000)
+    rounds = 8
+    base = {"objective": "binary", "num_leaves": 31,
+            "metric": "binary_logloss", "verbosity": -1,
+            "tree_growth": "frontier"}
+
+    def losses(params):
+        from lightgbm_tpu.io.dataset import BinnedDataset
+        from lightgbm_tpu.objectives import create_objective
+        from lightgbm_tpu.metrics import create_metric
+        from lightgbm_tpu.boosting import create_boosting
+        cfg = Config(params)
+        ds = BinnedDataset.from_matrix(X, cfg, label=y)
+        mets = [m for m in (create_metric(n_, cfg)
+                            for n_ in (cfg.metric or [])) if m]
+        b = create_boosting(cfg, ds, create_objective(cfg), mets)
+        out = []
+        for _ in range(rounds):
+            b.train_one_iter()
+            out.append(dict((m, v) for _, m, v, _ in b.get_eval_at(0))
+                       ["binary_logloss"])
+        return out
+
+    ls = losses(dict(base))
+    lv = losses(dict(base, tree_learner="voting", mesh_shape=[8], top_k=3))
+    # monotone convergence (strict early, tiny tolerance for late-round
+    # fp wiggle) and parity with the exact search at the end
+    assert all(b2 <= b1 + 1e-6 for b1, b2 in zip(lv, lv[1:]))
+    assert lv[-1] < lv[0] * 0.8
+    assert abs(lv[-1] - ls[-1]) < 0.1 * max(ls[0] - ls[-1], 1e-6)
