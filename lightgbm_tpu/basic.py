@@ -116,6 +116,16 @@ class Dataset:
         params = dict(self.params)
         cfg = Config(params)
 
+        if int(cfg.data_stream_chunk_rows) > 0 and self.reference is None \
+                and self.used_indices is None \
+                and not (isinstance(self.data, str)
+                         and self.data.endswith((".npz", ".bin"))):
+            # out-of-core path (docs/OutOfCore.md): the raw matrix is
+            # consumed chunk-by-chunk and never materialized whole.
+            # Validation sets (reference != None) and subsets stay on the
+            # in-memory path — they are bounded by construction.
+            return self._construct_streamed(cfg)
+
         data = self.data
         if isinstance(data, str):
             # file path; supports the "bin once" .npz cache
@@ -216,6 +226,74 @@ class Dataset:
             init_score=init_score, feature_names=feature_names,
             categorical_feature=cat, reference=ref_binned)
         self._raw_X = None if self.free_raw_data else X
+        return self
+
+    def _construct_streamed(self, cfg: Config) -> "Dataset":
+        """Out-of-core construction through ``lightgbm_tpu.stream``.
+
+        Picks a ChunkSource by input kind (.npy memory-map, delimited
+        text, in-memory array) and two-round ingests it into a
+        ``StreamedDataset`` whose uint8 chunks stay host-side until the
+        trainer's pipeline sweeps them.
+        """
+        from .stream import ArraySource, CsvSource, NpyMmapSource
+        from .stream.sampler import ingest
+        R = int(cfg.data_stream_chunk_rows)
+        data = self.data
+        label = self.label
+        weight, group, init_score = self.weight, self.group, self.init_score
+        pandas_cat_cols: List[str] = []
+        if isinstance(data, str):
+            from .io import parser as parser_mod
+            if data.endswith(".npy"):
+                src = NpyMmapSource(data, label=label, chunk_rows=R)
+            else:
+                src = CsvSource(data, chunk_rows=R, has_header=cfg.header,
+                                label_column=cfg.label_column)
+            # sidecar metadata files, same convention as the in-memory
+            # file path (src/io/metadata.cpp LoadFromFile)
+            if weight is None:
+                weight = parser_mod.load_weight_file(data)
+            if group is None:
+                group = parser_mod.load_query_file(data)
+            if init_score is None:
+                init_score = parser_mod.load_init_score_file(data)
+        else:
+            if hasattr(data, "dtypes") and hasattr(data, "columns"):
+                data, pandas_cat_cols, self.pandas_categorical = \
+                    _pandas_frame_to_array(data, self.pandas_categorical)
+            from .io.dataset import _is_sparse
+            if _is_sparse(data):
+                raise LightGBMError(
+                    "data_stream_chunk_rows does not support sparse "
+                    "input; pass a dense array or stream from .npy/text")
+            src = ArraySource(_to_2d_float(data), label=_to_1d(label),
+                              chunk_rows=R)
+
+        feature_names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+        cat = self.categorical_feature
+        if cat == "auto" or cat is None:
+            cat = None
+        if pandas_cat_cols:
+            cat = list(cat) if cat else []
+            cat.extend(c for c in pandas_cat_cols if c not in cat)
+
+        binned = ingest(src, cfg, feature_names=feature_names,
+                        categorical_feature=cat)
+        if label is not None and binned.metadata.label is None:
+            binned.metadata.set_label(_to_1d(label))
+        if weight is not None:
+            binned.metadata.set_weight(_to_1d(weight))
+        if group is not None:
+            binned.metadata.set_query(_to_1d(group))
+        if init_score is not None:
+            binned.metadata.set_init_score(np.asarray(init_score))
+        self._binned = binned
+        self._raw_X = None
         return self
 
     def create_valid(self, data, label=None, weight=None, group=None,

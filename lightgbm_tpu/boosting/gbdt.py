@@ -263,6 +263,14 @@ class GBDT:
         self.mesh = None
         self._row_valid = None
         self._frontier_rs = False
+        # out-of-core streamed training (lightgbm_tpu.stream): the chunk
+        # pipeline, the host-driven grower, and its pre/post jits — set by
+        # _setup_train when the dataset is a StreamedDataset
+        self._stream = None
+        self._stream_grower = None
+        self._stream_pre = None
+        self._stream_post = None
+        self._stream_capture = ()
         # observability facade (lightgbm_tpu.obs): replaced by the
         # config-driven one in _setup_train; loaded/predict-only boosters
         # keep the disabled no-op
@@ -280,6 +288,32 @@ class GBDT:
         self.num_data_orig = ds.num_data
         xb_np = ds.X_binned
         row_valid = None
+        streamed = bool(getattr(ds, "is_streamed", False))
+        if streamed:
+            # out-of-core path: the bin matrix exists only as host chunks;
+            # everything per-row stays device-resident at padded length
+            if self.mesh is not None:
+                raise LightGBMError(
+                    "streamed training is single-device; unset mesh_shape "
+                    "(chunks x devices is tracked in ROADMAP.md)")
+            if cfg.tree_growth != "frontier":
+                raise LightGBMError(
+                    "streamed training requires tree_growth=frontier")
+            if _hist_dtype(cfg) == "f64":
+                raise LightGBMError(
+                    "streamed training accumulates f32 wave histograms; "
+                    "set gpu_use_dp=false")
+            from ..stream.pipeline import ChunkPipeline
+            chunk_cap = int(cfg.data_stream_chunk_rows) or \
+                max(1, max(ds.chunk_row_counts))
+            self._stream = ChunkPipeline(
+                ds.chunks, chunk_cap,
+                prefetch=int(cfg.data_stream_prefetch))
+            pad = self._stream.num_padded - ds.num_data
+            if pad:
+                row_valid = np.concatenate(
+                    [np.ones(ds.num_data, np.float32),
+                     np.zeros(pad, np.float32)])
         if self.mesh is not None:
             # pad rows to a multiple of the data-axis size so every shard is
             # even; padded rows carry mask 0 everywhere (the distributed
@@ -322,8 +356,10 @@ class GBDT:
                 "EFB bundles / nbit-packed columns are not yet supported "
                 "with a device mesh; set enable_bundle=false and "
                 "enable_nbit_packing=false for distributed training")
-        self.num_data = xb_np.shape[0]
-        self._feature_pad = xb_np.shape[1] - ds.num_columns
+        self.num_data = (self._stream.num_padded if streamed
+                         else xb_np.shape[0])
+        self._feature_pad = (0 if streamed
+                             else xb_np.shape[1] - ds.num_columns)
         self._row_valid = (jnp.asarray(row_valid) if row_valid is not None
                            else None)
         self.feature_meta = _pad_feature_meta(
@@ -344,7 +380,7 @@ class GBDT:
             and not cfg.cegb_penalty_feature_coupled
             and not cfg.cegb_penalty_feature_lazy
             and cfg.cegb_penalty_split <= 0)
-        self.xb = jnp.asarray(xb_np)
+        self.xb = None if streamed else jnp.asarray(xb_np)
         self._fp_capture = None
         if self._explicit_fp:
             # xb stays replicated (every FP worker holds the full data,
@@ -358,6 +394,10 @@ class GBDT:
             self.objective.init(ds.metadata, ds.num_data)
             if self.mesh is not None:
                 self.objective.pad_to(self.num_data, self.mesh)
+            elif streamed and self.num_data > ds.num_data:
+                # chunk-uniform padding: per-row objective arrays stretch
+                # to the padded length; padded rows are masked everywhere
+                self.objective.pad_to(self.num_data)
         for m in self.train_metrics:
             m.init(ds.metadata, ds.num_data)
 
@@ -371,7 +411,7 @@ class GBDT:
         # hold the psum a sharded rebuild needs (same SPMD constraint the
         # growth loop documents for its dead-iteration histograms)
         if cfg.histogram_pool_size > 0 and cfg.tree_learner != "voting" \
-                and self.mesh is None:
+                and self.mesh is None and not streamed:
             bytes_per_hist = xb_np.shape[1] * self.num_bins * 3 * 4
             pool_slots = int(cfg.histogram_pool_size * 1024 * 1024
                              // max(bytes_per_hist, 1))
@@ -519,8 +559,13 @@ class GBDT:
             frontier_rs=(frontier_mode and self._frontier_rs),
             # wave-width bucketing: off under vmapped multiclass growth —
             # vmap lowers the width switch to execute-ALL-branches, which
-            # costs ~2x the fixed-width wave instead of saving it
+            # costs ~2x the fixed-width wave instead of saving it. Also
+            # off when streaming: a ladder would multiply the per-chunk
+            # kernel set by its length and make the compiled-program
+            # count depend on which widths a run visits (the perf gate
+            # pins that count invariant in chunk count)
             frontier_bucketing=(frontier_mode and not vmapped
+                                and not streamed
                                 and bool(cfg.tpu_frontier_bucketing)),
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
@@ -545,6 +590,15 @@ class GBDT:
             # back to host-side recomputation at materialize
             obs_modelstats=(frontier_mode and not self._partition_on_mesh
                             and bool(cfg.obs_modelstats)))
+
+        if streamed:
+            if not frontier_mode:
+                raise LightGBMError(
+                    "streamed training requires the frontier wave grower "
+                    "(tree_growth=frontier with f32 histograms)")
+            from ..stream.grow_stream import StreamFrontierGrower
+            self._stream_grower = StreamFrontierGrower(
+                self._stream, self.feature_meta, self.grow_params)
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -1119,6 +1173,201 @@ class GBDT:
         self._iter_core = run_iter   # unjitted: train_many scans over it
         return jax.jit(run_iter)
 
+    def _make_stream_iter_fns(self) -> None:
+        """Build the two jitted halves of a streamed iteration.
+
+        The grower itself (StreamFrontierGrower) is host-driven, so the
+        per-iteration device work splits around it: ``stream_pre`` turns
+        scores into (possibly GOSS-resampled) gradients, ``stream_post``
+        applies the grown trees to the scores with the same renew /
+        stop-latch / health semantics as ``run_iter``. Both take the
+        objective's per-row arrays as arguments (``_stream_capture``),
+        matching the non-streamed capture convention.
+        """
+        obj = self.objective
+        k = self.num_tree_per_iteration
+        n = self.num_data
+        obj_row_names = tuple(sorted(
+            nm for nm, v in (obj.__dict__.items() if obj is not None else ())
+            if isinstance(v, jnp.ndarray) and v.ndim >= 1
+            and v.shape[0] in (n, self.num_data_orig)))
+        self._stream_capture = tuple(getattr(obj, nm)
+                                     for nm in obj_row_names)
+        import copy as _copy
+
+        def bind(obj_rows):
+            o = _copy.copy(obj)
+            for nm, v in zip(obj_row_names, obj_rows):
+                setattr(o, nm, v)
+            return o
+
+        health_on = self.obs.health_enabled
+        is_goss = self.boosting_type == "goss"
+        if is_goss:
+            n_real = self.num_data_orig
+            top_cnt = max(1, int(n_real * self.config.top_rate))
+            other_cnt = max(1, int(n_real * self.config.other_rate))
+            goss_multiply = float(n_real - top_cnt) / other_cnt
+        row_valid = self._row_valid
+        renew_alpha = None
+        renew_w_attr = None
+        if obj is not None \
+                and getattr(obj, "renew_percentile", None) is not None:
+            renew_alpha = float(obj.renew_percentile())
+            renew_w_attr = ("label_weight" if obj.name == "mape"
+                            else "weights")
+
+        def stream_pre(obj_rows, scores, sample_mask, goss_active,
+                       goss_key):
+            o = bind(obj_rows)
+            if k == 1:
+                g, h = o.get_gradients(scores[:, 0])
+                g = g[:, None]
+                h = h[:, None]
+            else:
+                g, h = o.get_gradients(scores)
+            if is_goss:
+                def goss_mult(_):
+                    gh = jnp.sum(jnp.abs(g * h), axis=1)
+                    if row_valid is not None:
+                        # padded rows accumulate leaf deltas of whatever
+                        # leaf id their slot happens to carry, so unlike
+                        # the mesh-padding case their |g*h| is NOT zero —
+                        # mask before ranking or they'd occupy top-k slots
+                        gh = gh * row_valid
+                    thr = jax.lax.top_k(gh, top_cnt)[0][-1]
+                    is_top = gh >= thr
+                    u = jax.random.uniform(goss_key, (n,))
+                    p_rest = other_cnt / max(n_real - top_cnt, 1)
+                    keep_other = (~is_top) & (u < p_rest)
+                    return jnp.where(is_top, 1.0,
+                                     jnp.where(keep_other, goss_multiply,
+                                               0.0))
+
+                mult = jax.lax.cond(goss_active > 0, goss_mult,
+                                    lambda _: jnp.ones((n,), jnp.float32),
+                                    operand=None)
+                g = g * mult[:, None]
+                h = h * mult[:, None]
+                sample_mask = sample_mask * (mult > 0).astype(jnp.float32)
+            return g, h, sample_mask
+
+        def stream_post(obj_rows, trees, leaf_ids, scores, sample_mask,
+                        g, h, grower_health, lr, stopped_in):
+            if renew_alpha is not None:
+                from ..core.renew import renew_leaf_values
+                o = bind(obj_rows)
+                rw = getattr(o, renew_w_attr, None)
+                if rw is None:
+                    rw = jnp.ones_like(o.label)
+
+                def renew_one(t, li, sc_col):
+                    lab = getattr(o, "trans_label", None)
+                    lab = o.label if lab is None else lab
+                    new_lv = renew_leaf_values(
+                        lab - sc_col, rw, li, sample_mask,
+                        self.grow_params.num_leaves, renew_alpha,
+                        t.leaf_value)
+                    return t._replace(leaf_value=new_lv)
+
+                trees = jax.vmap(renew_one, in_axes=(0, 0, 1))(
+                    trees, leaf_ids, scores)
+            deltas = jax.vmap(
+                lambda t, li: t.leaf_value[li] * lr)(trees, leaf_ids)
+            any_split = jnp.any(trees.num_leaves > 1)
+            stopped_out = stopped_in | ~any_split
+            apply = (any_split & ~stopped_in).astype(jnp.float32)
+            new_scores = scores + deltas.T * apply
+            if health_on:
+                from ..obs.health import health_vec
+                health = health_vec(g, h, any_split, grower_health)
+            else:
+                health = jnp.zeros((4,), jnp.float32)
+            return pack_trees(trees), new_scores, stopped_out, health
+
+        self._stream_pre = jax.jit(stream_pre)
+        self._stream_post = jax.jit(stream_post)
+
+    def _train_one_iter_streamed(self) -> bool:
+        """Streamed TrainOneIter: host wave loop over device chunks.
+
+        Same dispatch/flush contract as ``train_one_iter`` — trees stay
+        packed on device until `_materialize` — but the grower is the
+        host-driven StreamFrontierGrower, so the iteration is three
+        stages: jitted gradient pre-pass, per-class chunk-swept growth,
+        jitted score/stop post-pass.
+        """
+        if self._stopped:
+            return True
+        _faults.inject("train_dispatch", iteration=self.iter_)
+        self._boost_from_average()
+        if self._stream_pre is None:
+            self._make_stream_iter_fns()
+
+        iter_idx = self.iter_
+        obs = self.obs
+        t0 = time.perf_counter() if obs.enabled else 0.0
+        sample_mask = self._sample_bagging_mask(iter_idx)
+        feature_mask = self._sample_feature_mask()
+        self._bag_key, goss_key = jax.random.split(self._bag_key)
+        obs.perfetto_step(iter_idx, iter_idx + 1)
+        t_disp = t0
+        params = self.grow_params
+        k = self.num_tree_per_iteration
+        with obs.span("train_iter", iteration=iter_idx):
+            g, h, sm = self._stream_pre(
+                self._stream_capture, self.scores, sample_mask,
+                jnp.float32(self._goss_active(iter_idx)), goss_key)
+            trees_l, lids_l, aux_l = [], [], []
+            for c in range(k):
+                t, li, aux = self._stream_grower.grow(
+                    g[:, c], h[:, c], sm, feature_mask)
+                trees_l.append(t)
+                lids_l.append(li)
+                aux_l.append(aux)
+            trees = jax.tree.map(lambda *a: jnp.stack(a), *trees_l)
+            leaf_ids = jnp.stack(lids_l)
+            grower_health = None
+            mstats = None
+            if params.obs_modelstats:
+                if aux_l[0][0] is not None:
+                    grower_health = jnp.stack([a[0] for a in aux_l])
+                mstats = jnp.stack([a[1] for a in aux_l])
+            elif params.obs_health:
+                grower_health = jnp.stack(aux_l)
+            packed, new_scores, self._stopped_dev, health = \
+                self._stream_post(
+                    self._stream_capture, trees, leaf_ids, self.scores,
+                    sm, g, h, grower_health,
+                    jnp.float32(self.shrinkage_rate), self._stopped_dev)
+            if obs.enabled:
+                t_disp = time.perf_counter()
+                jax.block_until_ready(new_scores)  # lgbm-lint: disable=LGL103 span close
+        t_done = time.perf_counter() if obs.enabled else 0.0
+        self.scores = new_scores
+
+        pend: Dict[str, Any] = {"packed": packed[None],
+                                "shrinkage": self.shrinkage_rate,
+                                "count": 1,
+                                "mstats": (mstats[None]
+                                           if mstats is not None else None)}
+        self._pending.append(pend)
+        self.iter_ += 1
+        if obs.enabled:
+            hrow = np.asarray(health)[None]
+            obs.dispatch_done(iter_idx, 1, t_done - t0,
+                              health_rows=hrow,
+                              busy_s=t_disp - t0, wait_s=t_done - t_disp)
+            if obs.per_iteration:
+                obs.record_hbm()
+            obs.check_health(hrow, iter_idx, booster=self)
+        elif obs.health_enabled:
+            obs.check_health(np.asarray(health)[None], iter_idx,
+                             booster=self)
+        if sum(p["count"] for p in self._pending) >= self._flush_every:
+            return self._materialize()
+        return False
+
     # the block's threaded train-state buffers by run_block position:
     # scores [N, K] and the bagging mask [N].  One declaration, three
     # consumers: the executing jit below, the donation audit
@@ -1246,9 +1495,11 @@ class GBDT:
         from ..profiling import backend_compile_count, compile_cache_stats
         params = self.grow_params
         if not getattr(params, "frontier_mode", False) or \
-                self.mesh is not None:
-            # mesh growth compiles inside shard_map on shard-local shapes;
-            # the standalone global-shape warmup would not match it
+                self.mesh is not None or self.xb is None:
+            # mesh growth compiles inside shard_map on shard-local shapes,
+            # and streamed growth (self.xb is None) compiles its own
+            # fixed-chunk kernels on first dispatch; the standalone
+            # global-shape warmup would not match either
             return {"widths": [], "per_bucket_compiles": {},
                     "seconds": 0.0, "cache_hits": 0, "cache_misses": 0}
         from ..core.histogram import build_histogram_frontier
@@ -1328,7 +1579,8 @@ class GBDT:
                 *self.train_block_sds(block),
                 extra_key="block=%d" % block)
         params = self.grow_params
-        if getattr(params, "frontier_mode", False) and self.mesh is None:
+        if getattr(params, "frontier_mode", False) and self.mesh is None \
+                and self.xb is not None:
             # mesh growth lowers inside shard_map on shard-local shapes;
             # the standalone global-shape entry would not price it
             from .. import bucketing
@@ -1344,6 +1596,18 @@ class GBDT:
                     n, ncols, self.xb.dtype, params, w)
                 name = "frontier_hist_w%d" % w
                 out[name] = cm.analyze(name, hfn, *hargs, **hkw)
+        if self._stream is not None:
+            # streamed growth: one fixed-width per-chunk sweep is the
+            # whole kernel story — price it at the pipeline's chunk shape
+            from .. import bucketing
+            from ..core.grow_frontier import wave_hist_entry
+            w = bucketing.frontier_max_width(params.num_leaves,
+                                             params.max_depth)
+            hfn, hargs, hkw = wave_hist_entry(
+                self._stream.chunk_rows, self._stream.num_cols,
+                jnp.uint8, params, w)
+            name = "stream_chunk_hist_w%d" % w
+            out[name] = cm.analyze(name, hfn, *hargs, **hkw)
         flush = list(getattr(self, "_last_flush_shapes", ()))
         if flush:
             concat = jax.jit(lambda *bufs: jnp.concatenate(bufs, axis=0))
@@ -1361,7 +1625,12 @@ class GBDT:
         their leaf refit runs in-graph (core/renew.py).
         """
         eligible = (self.boosting_type in ("gbdt", "goss")
-                    and not self._use_input_grads)
+                    and not self._use_input_grads
+                    # streamed growth is host-driven (per-chunk kernels
+                    # under a host wave loop) — it cannot fuse into one
+                    # scanned device program; per-iteration dispatch is
+                    # the streamed fast path
+                    and self._stream is None)
         if eligible and self.obs.per_iteration:
             # observability=full wants TRUE per-iteration spans and
             # health-within-one-iteration, so it forgoes block fusion —
@@ -1625,6 +1894,13 @@ class GBDT:
         """
         if self._stopped:
             return True
+        if self._stream is not None:
+            if grad is not None or self._use_input_grads:
+                raise LightGBMError(
+                    "streamed training does not support externally "
+                    "supplied gradients; use a built-in objective or "
+                    "unset data_stream_chunk_rows")
+            return self._train_one_iter_streamed()
         _faults.inject("train_dispatch", iteration=self.iter_)
         self._boost_from_average()
         self._maybe_warm_ladder()
@@ -2005,6 +2281,11 @@ class GBDT:
         """GBDT::RollbackOneIter (gbdt.cpp:414-430)."""
         if self.iter_ <= 0:
             return
+        if self._stream is not None:
+            raise LightGBMError(
+                "rollback_one_iter needs the full binned matrix to replay "
+                "dropped trees; it is not supported with streamed "
+                "training (data_stream_chunk_rows > 0)")
         k = self.num_tree_per_iteration
         dropped = self.models[-k:]
         del self.models[-k:]

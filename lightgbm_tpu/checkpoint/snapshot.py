@@ -78,6 +78,20 @@ def dataset_fingerprint(binned) -> str:
     if xb is not None:
         h.update(np.ascontiguousarray(xb).tobytes())
         h.update(repr(xb.shape).encode())
+    elif getattr(binned, "is_streamed", False):
+        # streamed dataset: no single matrix to hash. The fingerprint is
+        # the bin layout (mapper boundaries — two sources that bin
+        # differently must not resume each other) plus the ordered chunk
+        # contents; chunking itself is NOT hashed beyond order, so the
+        # same rows in the same order with a different chunk_rows still
+        # match (the trained model is chunking-invariant by construction)
+        for m in binned.bin_mappers:
+            h.update(repr(sorted(m.to_dict().items())).encode())
+        for c in binned.chunks:
+            h.update(np.ascontiguousarray(c).tobytes())
+        h.update(repr((binned.num_data,
+                       binned.chunks[0].shape[1] if binned.chunks
+                       else 0)).encode())
     label = getattr(binned.metadata, "label", None)
     if label is not None:
         h.update(np.ascontiguousarray(np.asarray(label)).tobytes())

@@ -221,6 +221,19 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # permutation measured slower than the kernel savings on chip
     # (docs/Performance.md); true forces it on for experiments.
     ("tpu_batched_part", str, "auto", []),
+    # out-of-core streamed training (lightgbm_tpu.stream;
+    # docs/OutOfCore.md): > 0 caps the rows of each host-resident binned
+    # chunk — the dataset is ingested two-round (sample-based bin
+    # boundaries, per-chunk quantize) and trained with per-chunk wave
+    # histograms summed before split finding (additive, so the grown
+    # structure matches single-shot at the same boundaries). 0 = off
+    # (whole dataset in one device allocation). Requires
+    # tree_growth=frontier and boosting gbdt/goss; single device only.
+    ("data_stream_chunk_rows", int, 0, ["stream_chunk_rows"]),
+    # chunks kept in flight ahead of the sweep cursor: each is
+    # jax.device_put BEFORE the previous chunk's histogram kernel needs
+    # it, so host->device transfer overlaps device compute
+    ("data_stream_prefetch", int, 2, ["stream_prefetch"]),
     # rows per chunk of the partitioned growth loops (core/partition.py).
     # 0 = auto: 4096 on TPU-shaped backends (measured round-4 winner:
     # most leaves are far smaller than the old 16384 default, whose
@@ -540,6 +553,36 @@ class Config:
         if self.tpu_row_chunk < 0:
             raise LightGBMError("tpu_row_chunk should be >= 0 (0 = auto), "
                                 "got %s" % self.tpu_row_chunk)
+        if self.data_stream_chunk_rows < 0:
+            raise LightGBMError("data_stream_chunk_rows should be >= 0 "
+                                "(0 = off), got %s"
+                                % self.data_stream_chunk_rows)
+        if self.data_stream_prefetch < 1:
+            raise LightGBMError("data_stream_prefetch should be >= 1, got %s"
+                                % self.data_stream_prefetch)
+        if self.data_stream_chunk_rows > 0:
+            # the streamed trainer is the frontier grower driven from the
+            # host; every incompatible combination fails HERE, at config
+            # time, not deep inside the training dispatch
+            if self.tree_growth != "frontier":
+                raise LightGBMError(
+                    "data_stream_chunk_rows requires tree_growth=frontier "
+                    "(cross-chunk histogram accumulation rides the wave "
+                    "sweep); got tree_growth=%s" % self.tree_growth)
+            if self.boosting not in ("gbdt", "goss"):
+                raise LightGBMError(
+                    "data_stream_chunk_rows supports boosting gbdt/goss "
+                    "only (dart/rf replay full binned data per iteration); "
+                    "got boosting=%s" % self.boosting)
+            if self.mesh_shape:
+                raise LightGBMError(
+                    "data_stream_chunk_rows does not compose with a device "
+                    "mesh yet (chunks x devices is tracked in ROADMAP.md); "
+                    "clear mesh_shape or data_stream_chunk_rows")
+            if self.gpu_use_dp:
+                raise LightGBMError(
+                    "data_stream_chunk_rows accumulates f32 wave "
+                    "histograms; gpu_use_dp (f64) is not supported")
         if self.top_k < 1:
             raise LightGBMError("top_k should be >= 1 (voting-parallel "
                                 "candidate count), got %s" % self.top_k)

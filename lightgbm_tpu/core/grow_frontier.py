@@ -153,6 +153,168 @@ def _route_rows_gather(xb, rs, cur, meta, with_efb, with_categorical):
         (cur.cat_bitset[rs] if with_categorical else None))
 
 
+def wave_plan(best, nl, kw: int, l: int):
+    """Wave bookkeeping that depends only on per-leaf state (no dataset
+    access): the gain-ranked top-k frontier, its commit mask, node/leaf
+    numbering, the gathered split records, and the leaf->rank map.
+    Shared verbatim by the in-memory wave (``wave_step``) and the
+    streamed grower (stream/grow_stream.py), which runs it once per wave
+    BEFORE touching any chunk."""
+    rank = jnp.arange(kw, dtype=jnp.int32)
+    gval, gleaf = lax.top_k(best.gain, kw)    # distinct leaves, desc
+    # the whole positive-gain frontier splits, gain-ranked; both
+    # conditions are prefix masks of the sorted ranks
+    valid = (gval > 0.0) & (rank < (l - nl))
+    nvalid = jnp.sum(valid.astype(jnp.int32))
+    node = (nl - 1) + rank                    # [kw]
+    right_leaf = nl + rank                    # [kw]
+    cur = jax.tree.map(lambda a: a[gleaf], best)     # fields [kw]
+    rank_of_leaf = jnp.full((l,), -1, jnp.int32)
+    rank_of_leaf = _drop_set(rank_of_leaf, gleaf, rank, valid)
+    return gval, gleaf, valid, nvalid, node, right_leaf, cur, rank_of_leaf
+
+
+def wave_route(xb, leaf_id, cur, rank_of_leaf, right_leaf, meta,
+               with_efb: bool, with_categorical: bool):
+    """Route a batch of rows through their leaf's committed split.
+    Works on any row slice whose ``leaf_id`` it is given — the full
+    dataset in-memory, one resident chunk when streaming."""
+    r_r = rank_of_leaf[leaf_id]               # [N], -1 = not splitting
+    active = r_r >= 0
+    rs = jnp.maximum(r_r, 0)
+    go_left = _route_rows_gather(xb, rs, cur, meta, with_efb,
+                                 with_categorical)
+    new_leaf_id = jnp.where(active & ~go_left, right_leaf[rs], leaf_id)
+    return new_leaf_id, active, rs, go_left
+
+
+def wave_slots(cur, active, go_left, rs):
+    """Histogram slot of every row: its split's rank iff it lands in
+    the SMALLER child, else -1 (the larger sibling comes from the pool
+    by subtraction, so the sweep touches each splitting row at most
+    once)."""
+    left_small = cur.left_count <= cur.right_count       # [kw]
+    in_small = active & (go_left == left_small[rs])
+    slot = jnp.where(in_small, rs, -1)
+    return left_small, slot
+
+
+def wave_commit(s: "_FrontierState", kw: int, l: int, gval, gleaf, valid,
+                nvalid, node, right_leaf, cur, left_small, hist_small,
+                meta: FeatureMeta, sp, max_depth: int, lrn):
+    """Everything after the wave's dataset sweep: sibling derivation from
+    the pool, pool update, tree bookkeeping, the 2K-children best-split
+    search, and the health/mstats accumulators. ``hist_small`` is the
+    learner-reduced [kw, C, B, 3] smaller-child tensor — one sweep
+    in-memory, a sum of per-chunk sweeps when streaming (histograms are
+    additive, so the commit is identical either way)."""
+    parent_hist = s.hist_pool[jnp.where(valid, gleaf, 0)]
+    hist_large = parent_hist - hist_small
+    ls = left_small[:, None, None, None]
+    hist_left = jnp.where(ls, hist_small, hist_large)
+    hist_right = jnp.where(ls, hist_large, hist_small)
+
+    # pool update: left child reuses the parent's leaf index, right
+    # child takes its new leaf; invalid lanes drop
+    pool = s.hist_pool
+    pool = pool.at[jnp.where(valid, gleaf, l)].set(
+        hist_left, mode="drop")
+    pool = pool.at[jnp.where(valid, right_leaf, l)].set(
+        hist_right, mode="drop")
+
+    # ---- tree bookkeeping for the wave (shared with grow_batched) ---
+    (tree, leaf_min, leaf_max, safe_leaf,
+     ch_min, ch_max, ch_ok) = apply_split_wave(
+        s.tree, s.leaf_min, s.leaf_max, cur, gleaf, node, right_leaf,
+        valid, nvalid, meta, sp, max_depth)
+
+    # ---- best splits for all 2K children, one vmapped search --------
+    ch_hist = jnp.stack([hist_left, hist_right],
+                        axis=1).reshape((2 * kw,) + hist_left.shape[1:])
+    ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
+    ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
+    ch_cnt = interleave_lr(cur.left_count, cur.right_count)
+    b2k = lrn.best_children(ch_hist, ch_sg, ch_sh, ch_cnt,
+                            ch_min, ch_max)
+    b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
+    best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
+
+    health = s.health
+    if health is not None:
+        # committed lanes must be finite (NaN/-inf never pass
+        # gval > 0, +inf does); child searches may only return real
+        # gains or the -inf sentinel
+        bad_gain = jnp.any(~jnp.isfinite(gval) & valid) | \
+            jnp.any(_gain_anomaly(b2k.gain))
+        health = jnp.stack([health[0] + 1.0,
+                            jnp.maximum(health[1],
+                                        bad_gain.astype(jnp.float32))])
+
+    mstats = s.mstats
+    if mstats is not None:
+        # committed lanes' inner feature + ranked gain, values the
+        # wave computed anyway — two scatter-adds + a scatter-max,
+        # zero new collectives
+        mstats = update_mstats(mstats, cur.feature, gval, valid)
+
+    return pool, tree, leaf_min, leaf_max, best, health, mstats
+
+
+def root_state(hist_root, root_g, root_h, root_c, n: int, l: int, sp,
+               lrn, params: GrowParams, feature_mask,
+               axis_name: Optional[str]) -> "_FrontierState":
+    """Seed the frontier state from the root's (already learner-reduced)
+    histogram and psum'd gradient sums — tree arrays, per-leaf best
+    records, the histogram pool, and the obs accumulators. Shared by the
+    in-memory grower and the streamed one (which sums the root histogram
+    over chunks first)."""
+    tree = empty_tree(l)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(
+            calculate_leaf_output(root_g, root_h, sp.lambda_l1, sp.lambda_l2,
+                                  sp.max_delta_step)),
+        leaf_weight=tree.leaf_weight.at[0].set(root_h),
+        leaf_count=tree.leaf_count.at[0].set(root_c))
+    best0 = lrn.best_root(hist_root, root_g, root_h, root_c)
+    best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
+
+    # per-leaf histogram pool: a frontier leaf's histogram survives from
+    # the wave that created it, so the subtraction trick works wave-wide
+    # (parent - smaller child = larger child; histogram.cpp:xx Subtract).
+    # Shape follows the learner's reduced histogram: full [C, B, 3] on the
+    # serial/voting schedules, the device's feature shard under data_rs
+    hist_pool = jnp.zeros((l,) + hist_root.shape, jnp.float32)
+    if lrn.varying_pool:
+        # the pool holds device-varying content (local histograms under
+        # voting, per-device feature shards under data_rs)
+        hist_pool = pcast(hist_pool, (axis_name,), to="varying")
+    hist_pool = hist_pool.at[0].set(hist_root)
+
+    leaf_id0 = jnp.zeros((n,), jnp.int32)
+    if axis_name is not None:
+        leaf_id0 = pcast(leaf_id0, (axis_name,), to="varying")
+    # health accumulator (obs): waves executed + anomalous gain, seeded
+    # with the root search's gain — everything below reads values the
+    # wave already computed, so no new sweeps or collectives. Anomalous
+    # means NaN or +inf: K_MIN_SCORE (-inf) is the legitimate "no valid
+    # split" sentinel and must not flag.
+    health0 = None
+    if params.obs_health:
+        health0 = jnp.stack([
+            jnp.float32(0.0),
+            jnp.any(_gain_anomaly(best0.gain)).astype(jnp.float32)])
+    # model-statistics accumulator (obs.modelstats): zeros are correct —
+    # EVERY committed split, the root's included, flows through a
+    # wave_step commit and scatters there
+    mstats0 = (init_mstats(feature_mask.shape[0])
+               if params.obs_modelstats else None)
+    return _FrontierState(
+        leaf_id=leaf_id0, hist_pool=hist_pool, best=best, tree=tree,
+        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
+        leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
+        health=health0, mstats=mstats0)
+
+
 def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
                        hess: jnp.ndarray, sample_mask: jnp.ndarray,
                        meta: FeatureMeta, feature_mask: jnp.ndarray,
@@ -205,51 +367,8 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
                                            num_bins=b,
                                            row_chunk=params.row_chunk,
                                            impl=params.hist_impl))
-    tree = empty_tree(l)
-    tree = tree._replace(
-        leaf_value=tree.leaf_value.at[0].set(
-            calculate_leaf_output(root_g, root_h, sp.lambda_l1, sp.lambda_l2,
-                                  sp.max_delta_step)),
-        leaf_weight=tree.leaf_weight.at[0].set(root_h),
-        leaf_count=tree.leaf_count.at[0].set(root_c))
-    best0 = lrn.best_root(hist_root, root_g, root_h, root_c)
-    best = jax.tree.map(lambda a, v: a.at[0].set(v), _empty_best(l), best0)
-
-    # per-leaf histogram pool: a frontier leaf's histogram survives from
-    # the wave that created it, so the subtraction trick works wave-wide
-    # (parent - smaller child = larger child; histogram.cpp:xx Subtract).
-    # Shape follows the learner's reduced histogram: full [C, B, 3] on the
-    # serial/voting schedules, the device's feature shard under data_rs
-    hist_pool = jnp.zeros((l,) + hist_root.shape, jnp.float32)
-    if lrn.varying_pool:
-        # the pool holds device-varying content (local histograms under
-        # voting, per-device feature shards under data_rs)
-        hist_pool = pcast(hist_pool, (axis_name,), to="varying")
-    hist_pool = hist_pool.at[0].set(hist_root)
-
-    leaf_id0 = jnp.zeros((n,), jnp.int32)
-    if axis_name is not None:
-        leaf_id0 = pcast(leaf_id0, (axis_name,), to="varying")
-    # health accumulator (obs): waves executed + anomalous gain, seeded
-    # with the root search's gain — everything below reads values the
-    # wave already computed, so no new sweeps or collectives. Anomalous
-    # means NaN or +inf: K_MIN_SCORE (-inf) is the legitimate "no valid
-    # split" sentinel and must not flag.
-    health0 = None
-    if params.obs_health:
-        health0 = jnp.stack([
-            jnp.float32(0.0),
-            jnp.any(_gain_anomaly(best0.gain)).astype(jnp.float32)])
-    # model-statistics accumulator (obs.modelstats): zeros are correct —
-    # EVERY committed split, the root's included, flows through a
-    # wave_step commit and scatters there
-    mstats0 = (init_mstats(feature_mask.shape[0])
-               if params.obs_modelstats else None)
-    state = _FrontierState(
-        leaf_id=leaf_id0, hist_pool=hist_pool, best=best, tree=tree,
-        leaf_min=jnp.full((l,), -jnp.inf, jnp.float32),
-        leaf_max=jnp.full((l,), jnp.inf, jnp.float32),
-        health=health0, mstats=mstats0)
+    state = root_state(hist_root, root_g, root_h, root_c, n, l, sp, lrn,
+                       params, feature_mask, axis_name)
 
     def cond_fn(s: _FrontierState) -> jnp.ndarray:
         return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
@@ -259,89 +378,30 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         caller guarantees the live positive-gain frontier fits in ``kw``
         lanes, so the top_k prefix it commits — and therefore the grown
         structure and numbering — is identical for every width."""
-        tree = s.tree
-        nl = tree.num_leaves                      # dynamic scalar
-        rank = jnp.arange(kw, dtype=jnp.int32)
-        gval, gleaf = lax.top_k(s.best.gain, kw)  # distinct leaves, desc
-        # the whole positive-gain frontier splits, gain-ranked; both
-        # conditions are prefix masks of the sorted ranks
-        valid = (gval > 0.0) & (rank < (l - nl))
-        nvalid = jnp.sum(valid.astype(jnp.int32))
-        node = (nl - 1) + rank                    # [kw]
-        right_leaf = nl + rank                    # [kw]
-        cur = jax.tree.map(lambda a: a[gleaf], s.best)   # fields [kw]
+        nl = s.tree.num_leaves                    # dynamic scalar
+        (gval, gleaf, valid, nvalid, node, right_leaf, cur,
+         rank_of_leaf) = wave_plan(s.best, nl, kw, l)
 
         # ---- route every row through its leaf's split -------------------
-        rank_of_leaf = jnp.full((l,), -1, jnp.int32)
-        rank_of_leaf = _drop_set(rank_of_leaf, gleaf, rank, valid)
-        r_r = rank_of_leaf[s.leaf_id]             # [N], -1 = not splitting
-        active = r_r >= 0
-        rs = jnp.maximum(r_r, 0)
-        go_left = _route_rows_gather(xb, rs, cur, meta, with_efb,
-                                     params.with_categorical)
-        leaf_id = jnp.where(active & ~go_left, right_leaf[rs], s.leaf_id)
+        leaf_id, active, rs, go_left = wave_route(
+            xb, s.leaf_id, cur, rank_of_leaf, right_leaf, meta, with_efb,
+            params.with_categorical)
 
         # ---- ONE dataset sweep: smaller child of every split ------------
         # slot = split rank iff the row lands in the SMALLER child of its
         # leaf's split, else -1 (inactive); the larger sibling is derived
         # from the pool by subtraction, so the sweep touches each
         # splitting row at most once and the wave costs one pass total
-        left_small = cur.left_count <= cur.right_count       # [kw]
-        in_small = active & (go_left == left_small[rs])
-        slot = jnp.where(in_small, rs, -1)
+        left_small, slot = wave_slots(cur, active, go_left, rs)
         hist_small = lrn.reduce(build_histogram_frontier(
             xb, slot, grad, hess, sample_mask, num_bins=b, num_slots=kw,
             row_chunk=params.row_chunk,
             impl=params.hist_impl))                # [kw, C, B, 3]
 
-        parent_hist = s.hist_pool[jnp.where(valid, gleaf, 0)]
-        hist_large = parent_hist - hist_small
-        ls = left_small[:, None, None, None]
-        hist_left = jnp.where(ls, hist_small, hist_large)
-        hist_right = jnp.where(ls, hist_large, hist_small)
-
-        # pool update: left child reuses the parent's leaf index, right
-        # child takes its new leaf; invalid lanes drop
-        pool = s.hist_pool
-        pool = pool.at[jnp.where(valid, gleaf, l)].set(
-            hist_left, mode="drop")
-        pool = pool.at[jnp.where(valid, right_leaf, l)].set(
-            hist_right, mode="drop")
-
-        # ---- tree bookkeeping for the wave (shared with grow_batched) ---
-        (tree, leaf_min, leaf_max, safe_leaf,
-         ch_min, ch_max, ch_ok) = apply_split_wave(
-            tree, s.leaf_min, s.leaf_max, cur, gleaf, node, right_leaf,
-            valid, nvalid, meta, sp, params.max_depth)
-
-        # ---- best splits for all 2K children, one vmapped search --------
-        ch_hist = jnp.stack([hist_left, hist_right],
-                            axis=1).reshape((2 * kw,) + hist_left.shape[1:])
-        ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
-        ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
-        ch_cnt = interleave_lr(cur.left_count, cur.right_count)
-        b2k = lrn.best_children(ch_hist, ch_sg, ch_sh, ch_cnt,
-                                ch_min, ch_max)
-        b2k = b2k._replace(gain=jnp.where(ch_ok, b2k.gain, K_MIN_SCORE))
-        best = scatter_child_best(s.best, b2k, safe_leaf, right_leaf, valid)
-
-        health = s.health
-        if health is not None:
-            # committed lanes must be finite (NaN/-inf never pass
-            # gval > 0, +inf does); child searches may only return real
-            # gains or the -inf sentinel
-            bad_gain = jnp.any(~jnp.isfinite(gval) & valid) | \
-                jnp.any(_gain_anomaly(b2k.gain))
-            health = jnp.stack([health[0] + 1.0,
-                                jnp.maximum(health[1],
-                                            bad_gain.astype(jnp.float32))])
-
-        mstats = s.mstats
-        if mstats is not None:
-            # committed lanes' inner feature + ranked gain, values the
-            # wave computed anyway — two scatter-adds + a scatter-max,
-            # zero new collectives
-            mstats = update_mstats(mstats, cur.feature, gval, valid)
+        (pool, tree, leaf_min, leaf_max, best, health,
+         mstats) = wave_commit(
+            s, kw, l, gval, gleaf, valid, nvalid, node, right_leaf, cur,
+            left_small, hist_small, meta, sp, params.max_depth, lrn)
 
         return _FrontierState(leaf_id=leaf_id, hist_pool=pool, best=best,
                               tree=tree, leaf_min=leaf_min,

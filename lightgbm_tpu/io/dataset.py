@@ -108,6 +108,10 @@ class BinnedDataset:
     user-facing lazy ``Dataset`` wrapper lives in ``lightgbm_tpu.basic``.
     """
 
+    # overridden by stream.sampler.StreamedDataset, whose bin matrix lives
+    # in host chunks (``chunks``) instead of ``X_binned``
+    is_streamed = False
+
     def __init__(self):
         self.num_data: int = 0
         self.num_total_features: int = 0

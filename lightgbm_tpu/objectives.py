@@ -74,14 +74,20 @@ class ObjectiveFunction:
             sh = row_sharding(mesh)
         self._host_rows = {}
         for name, val in list(self.__dict__.items()):
-            if isinstance(val, jnp.ndarray) and val.ndim == 1 \
-                    and val.shape[0] == n0:
-                self._host_rows[name] = np.asarray(val)
-                if pad > 0:
-                    val = jnp.concatenate([val, jnp.zeros((pad,), val.dtype)])
-                if sh is not None:
-                    val = jax.device_put(val, sh)
-                setattr(self, name, val)
+            if not (isinstance(val, jnp.ndarray) and val.ndim >= 1
+                    and val.shape[0] == n0):
+                continue
+            if val.ndim > 1 and sh is not None:
+                # mesh row_sharding is rank-1; 2-D per-row arrays
+                # (multiclass onehot) keep the mesh path's 1-D contract
+                continue
+            self._host_rows[name] = np.asarray(val)
+            if pad > 0:
+                val = jnp.concatenate(
+                    [val, jnp.zeros((pad,) + val.shape[1:], val.dtype)])
+            if sh is not None:
+                val = jax.device_put(val, sh)
+            setattr(self, name, val)
 
     def host(self, name: str):
         """Host numpy view of a per-row attribute — the pre-pad, pre-shard

@@ -143,6 +143,43 @@ class DataProfile:
             })
         return cls(feats, num_data=int(ds.num_data))
 
+    @classmethod
+    def from_binned_chunks(cls, ds) -> "DataProfile":
+        """Profile a ``StreamedDataset`` chunk-by-chunk.
+
+        Bin counts are additive over row partitions, so accumulating the
+        same per-feature decode (bundle offset, joint-pack unpack, clamp
+        to default_bin) per chunk yields bit-identical counts to
+        ``from_binned_dataset`` on the concatenated matrix — asserted in
+        tests/test_stream.py."""
+        (feat_col, feat_offset, _bundled, pack_div, pack_mod,
+         _partner) = ds.feature_layout()
+        nfeat = ds.num_features
+        mappers = [ds.bin_mappers[ds.real_feature_index(i)]
+                   for i in range(nfeat)]
+        counts = [np.zeros(m.num_bin, np.int64) for m in mappers]
+        for xb in ds.chunks:
+            xb = np.asarray(xb)
+            for i in range(nfeat):
+                m = mappers[i]
+                v = xb[:, int(feat_col[i])].astype(np.int64)
+                if int(pack_mod[i]) > 0:
+                    v = (v // max(int(pack_div[i]), 1)) % int(pack_mod[i])
+                v = v - int(feat_offset[i])
+                v = np.where((v >= 0) & (v < m.num_bin), v, m.default_bin)
+                counts[i] += np.bincount(v, minlength=m.num_bin)
+        feats: List[Dict] = []
+        for i in range(nfeat):
+            j = ds.real_feature_index(i)
+            feats.append({
+                "index": int(j),
+                "name": (ds.feature_names[j] if j < len(ds.feature_names)
+                         else "Column_%d" % j),
+                "mapper": mappers[i].to_dict(),
+                "counts": [int(c) for c in counts[i]],
+            })
+        return cls(feats, num_data=int(ds.num_data))
+
     # ----------------------------------------------------- serialization
     def to_json_dict(self) -> Dict:
         return {"version": PROFILE_VERSION, "num_data": self.num_data,

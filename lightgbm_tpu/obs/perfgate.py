@@ -244,7 +244,65 @@ def measure(workload: Optional[Dict[str, Any]] = None
         if wave is not None:
             counters["wave_collectives_" + suffix] = wave[0]
             counters["wave_payload_f32_" + suffix] = wave[1]
+    counters.update(_stream_counters(wl))
     return counters, wl
+
+
+def _stream_counters(wl: Dict[str, Any]) -> Dict[str, Any]:
+    """Out-of-core training counters (lightgbm_tpu.stream).
+
+    Three contracts pinned, all chunk-count-structural:
+
+    - ``stream_compile_chunk_invariance``: compiling the SAME workload at
+      2 vs 4 chunks must build the identical program set (the per-chunk
+      kernels are fixed-shape and the wave width is fixed, so chunk count
+      only changes how often each program runs) — the difference of the
+      two fresh-booster compile counts is exactly 0;
+    - ``stream_compiles_after_warmup``: further streamed iterations on a
+      warm booster compile NOTHING (exact 0);
+    - ``stream_sweeps_per_tree``: dataset sweeps per grown tree (one root
+      sweep + one per wave — the O(depth) sweep guarantee carried over
+      from the in-memory frontier grower).
+
+    A throwaway single-chunk run first absorbs every once-per-process
+    compile (shared jitted helpers) so the two measured runs see only
+    their own program sets."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from ..profiling import backend_compile_count, install_compile_hook
+
+    install_compile_hook()
+    rows = int(wl["rows"])
+    rng = np.random.RandomState(int(wl["seed"]))
+    X = rng.randn(rows, int(wl["features"])).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+
+    def run(num_chunks: int):
+        params = {"objective": "binary", "verbosity": -1,
+                  "num_leaves": int(wl["num_leaves"]),
+                  "max_depth": int(wl["max_depth"]),
+                  "tree_growth": "frontier", "observability": "none",
+                  "seed": int(wl["seed"]),
+                  "data_stream_chunk_rows": rows // num_chunks}
+        c0 = backend_compile_count()
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=int(wl["iters"]))
+        _ = bst._impl.models                 # force the flush
+        return bst._impl, float(backend_compile_count() - c0)
+
+    counters: Dict[str, Any] = {}
+    run(1)                                   # throwaway warm run
+    _, compiles2 = run(2)
+    b4, compiles4 = run(4)
+    counters["stream_compile_chunk_invariance"] = compiles4 - compiles2
+    c0 = backend_compile_count()
+    b4.train_many(int(wl["iters"]))
+    counters["stream_compiles_after_warmup"] = \
+        float(backend_compile_count() - c0)
+    counters["stream_sweeps_per_tree"] = round(
+        b4._stream.sweeps / max(b4._stream_grower.trees_grown, 1), 6)
+    return counters
 
 
 def _serving_counters(bst, num_features: int) -> Dict[str, Any]:

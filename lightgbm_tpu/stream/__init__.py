@@ -1,0 +1,33 @@
+"""Out-of-core streamed ingest, binning, and training (docs/OutOfCore.md).
+
+The subsystem that removes the "whole binned dataset in one device
+allocation" assumption (ROADMAP out-of-core item; reference
+``DatasetLoader``'s two-round sampled loading, PAPER.md §IO):
+
+- ``source``   — the ``ChunkSource`` contract + in-memory / npy-mmap /
+  CSV backends yielding bounded float chunks;
+- ``sampler``  — round 1: reservoir/stride sample over a source finds
+  the bin boundaries (io/binning.BinMapper, identical semantics to
+  ``BinnedDataset.from_file_two_round``); round 2: every chunk is
+  quantized host-side against that layout into uint8 ``StreamedDataset``
+  chunks;
+- ``pipeline`` — double-buffered host->device chunk transfer
+  (``jax.device_put`` of the next chunk overlapped with the current
+  chunk's histogram sweep) with ingest/overlap accounting;
+- ``grow_stream`` — the host-driven frontier grower: per-chunk wave
+  histograms summed before split finding (histograms are additive, so
+  chunked growth is structure-identical to single-shot at the same bin
+  boundaries).
+
+Activated by ``data_stream_chunk_rows > 0`` (config.py); the user-facing
+entry stays ``lgb.Dataset`` / ``lgb.train``.
+"""
+from .source import ArraySource, ChunkSource, CsvSource, NpyMmapSource
+from .sampler import StreamedDataset, ingest
+from .pipeline import ChunkPipeline
+from .grow_stream import StreamFrontierGrower
+
+__all__ = [
+    "ChunkSource", "ArraySource", "NpyMmapSource", "CsvSource",
+    "StreamedDataset", "ingest", "ChunkPipeline", "StreamFrontierGrower",
+]

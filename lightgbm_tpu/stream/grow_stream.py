@@ -1,0 +1,195 @@
+"""Host-driven frontier growth over device-streamed chunks.
+
+The in-memory frontier grower (core/grow_frontier.py) is one jitted
+``lax.while_loop`` over the whole [N, C] bin matrix. Here the matrix
+never fits on device, so the wave loop moves to the HOST and each wave's
+single dataset sweep becomes a sum of per-chunk sweeps — legal because
+histograms are additive over row partitions, which is the exact property
+that makes the result structure-identical to single-shot growth at the
+same bin boundaries (asserted in tests/test_stream.py).
+
+Everything per-ROW except the bin matrix (scores, grad/hess, sample
+mask, leaf ids) stays device-resident at full length, padded to
+``num_chunks * chunk_rows``; padding rows carry ``sample_mask == 0`` so
+they contribute exactly zero to every histogram channel and every
+gradient sum, and their (meaningless) leaf ids are never read.
+
+The wave is cut into three fixed-shape jitted kernels built from the
+SAME helpers the in-memory grower uses (wave_plan / wave_route /
+wave_slots / wave_commit / root_state):
+
+- ``_wave_begin``  — per-leaf planning + the loop condition (the ONE
+  host sync per wave: a single bool decides whether to sweep);
+- ``_chunk_wave``  — per chunk: dynamic-slice the chunk's rows out of
+  the full per-row arrays, route them, accumulate the smaller-child
+  histogram partial (fixed [R, C] chunk shape -> compiles once,
+  independent of how many chunks the dataset has);
+- ``_wave_commit`` — sibling subtraction, pool/tree/best updates.
+
+Wave width is FIXED at ``frontier_max_width`` (the bucketing ladder is
+disabled when streaming): a ladder would multiply the per-chunk kernel
+set by the ladder length and make the compiled-program count depend on
+which widths a run happens to visit — the perf gate pins that count
+invariant in chunk count instead.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..bucketing import frontier_max_width
+from ..core.grow import GrowParams, TreeArrays, expand_hist
+from ..core.grow_frontier import (_FrontierState, root_state, wave_commit,
+                                  wave_plan, wave_route, wave_slots)
+from ..core.histogram import build_histogram, build_histogram_frontier
+from ..core.split import FeatureMeta, find_best_split
+from ..log import check
+from ..parallel.learners import make_frontier_learner
+from .pipeline import ChunkPipeline
+
+
+class StreamFrontierGrower:
+    """Grows one tree per ``grow()`` call by sweeping a ChunkPipeline.
+
+    Same contract as ``grow_tree_frontier`` (tree, leaf_id, aux), with
+    per-row inputs at the pipeline's PADDED length. Single device only —
+    the chunks x devices composition is tracked in ROADMAP.md.
+    """
+
+    def __init__(self, pipeline: ChunkPipeline, meta: FeatureMeta,
+                 params: GrowParams):
+        check(not params.frontier_bucketing,
+              "streamed growth uses a fixed wave width; construct "
+              "GrowParams with frontier_bucketing=False")
+        self.pipeline = pipeline
+        self.params = params
+        self.trees_grown = 0
+        p = params
+        R = pipeline.chunk_rows
+        ncols = pipeline.num_cols
+        l = p.num_leaves
+        b = p.num_bins
+        sp = p.split
+        kb = frontier_max_width(l, p.max_depth)
+        self.wave_width = kb
+        self._hist_shape = (ncols, b, 3)
+        meta_ = meta
+
+        def make_lrn(fmask):
+            # the feature mask changes per tree (feature_fraction), so the
+            # learner closures bind it at trace time inside each kernel
+            def child_best(hist_col, sum_g, sum_h, cnt, min_c, max_c):
+                return find_best_split(
+                    expand_hist(hist_col, sum_g, sum_h, cnt, meta_, p,
+                                ncols),
+                    meta_, sp, sum_g, sum_h, cnt, fmask,
+                    min_constraint=min_c, max_constraint=max_c,
+                    with_categorical=p.with_categorical)
+
+            return make_frontier_learner(p, None, meta_, fmask,
+                                         lambda x: x, child_best)
+
+        def root_sums(grad, hess, mask):
+            return (jnp.sum(grad * mask), jnp.sum(hess * mask),
+                    jnp.sum(mask))
+
+        def root_chunk(xb_c, start, grad, hess, mask, acc):
+            g_c = lax.dynamic_slice(grad, (start,), (R,))
+            h_c = lax.dynamic_slice(hess, (start,), (R,))
+            m_c = lax.dynamic_slice(mask, (start,), (R,))
+            return acc + build_histogram(
+                xb_c, g_c, h_c, m_c, num_bins=b,
+                row_chunk=p.row_chunk, impl=p.hist_impl)
+
+        def root_commit(hist_acc, root_g, root_h, root_c, fmask):
+            lrn = make_lrn(fmask)
+            hist_root = lrn.reduce(hist_acc)
+            return root_state(hist_root, root_g, root_h, root_c,
+                              pipeline.num_padded, l, sp, lrn, p, fmask,
+                              axis_name=None)
+
+        def wave_begin(s: _FrontierState):
+            do = (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
+            plan = wave_plan(s.best, s.tree.num_leaves, kb, l)
+            return do, plan
+
+        def chunk_wave(xb_c, start, leaf_id, grad, hess, mask, plan,
+                       hist_acc):
+            (gval, gleaf, valid, nvalid, node, right_leaf, cur,
+             rank_of_leaf) = plan
+            lid_c = lax.dynamic_slice(leaf_id, (start,), (R,))
+            g_c = lax.dynamic_slice(grad, (start,), (R,))
+            h_c = lax.dynamic_slice(hess, (start,), (R,))
+            m_c = lax.dynamic_slice(mask, (start,), (R,))
+            new_lid, active, rs, go_left = wave_route(
+                xb_c, lid_c, cur, rank_of_leaf, right_leaf, meta_,
+                p.with_efb, p.with_categorical)
+            _left_small, slot = wave_slots(cur, active, go_left, rs)
+            part = build_histogram_frontier(
+                xb_c, slot, g_c, h_c, m_c, num_bins=b, num_slots=kb,
+                row_chunk=p.row_chunk, impl=p.hist_impl)
+            leaf_id = lax.dynamic_update_slice(leaf_id, new_lid, (start,))
+            return leaf_id, hist_acc + part
+
+        def wave_commit_fn(s: _FrontierState, plan, hist_small, leaf_id,
+                           fmask):
+            lrn = make_lrn(fmask)
+            (gval, gleaf, valid, nvalid, node, right_leaf, cur,
+             rank_of_leaf) = plan
+            left_small = cur.left_count <= cur.right_count
+            hs = lrn.reduce(hist_small)
+            (pool, tree, leaf_min, leaf_max, best, health,
+             mstats) = wave_commit(
+                s, kb, l, gval, gleaf, valid, nvalid, node, right_leaf,
+                cur, left_small, hs, meta_, sp, p.max_depth, lrn)
+            return _FrontierState(leaf_id=leaf_id, hist_pool=pool,
+                                  best=best, tree=tree, leaf_min=leaf_min,
+                                  leaf_max=leaf_max, health=health,
+                                  mstats=mstats)
+
+        self._root_sums = jax.jit(root_sums)
+        self._root_chunk = jax.jit(root_chunk)
+        self._root_commit = jax.jit(root_commit)
+        self._wave_begin = jax.jit(wave_begin)
+        self._chunk_wave = jax.jit(chunk_wave)
+        self._wave_commit = jax.jit(wave_commit_fn)
+
+    # ----------------------------------------------------------------- grow
+    def grow(self, grad: jnp.ndarray, hess: jnp.ndarray,
+             sample_mask: jnp.ndarray, feature_mask: jnp.ndarray
+             ) -> Tuple[TreeArrays, jnp.ndarray, Optional[jnp.ndarray]]:
+        """Grow one tree. ``grad``/``hess``/``sample_mask`` are full
+        padded-length device arrays; ``sample_mask`` must already be 0 on
+        padding rows (and on bagged-out / GOSS-dropped rows)."""
+        pipe = self.pipeline
+        R = pipe.chunk_rows
+        sample_mask = sample_mask.astype(jnp.float32)
+        root_g, root_h, root_c = self._root_sums(grad, hess, sample_mask)
+        acc = jnp.zeros(self._hist_shape, jnp.float32)
+        for i, xb_c in pipe.sweep():
+            acc = self._root_chunk(xb_c, jnp.int32(i * R), grad, hess,
+                                   sample_mask, acc)
+        state = self._root_commit(acc, root_g, root_h, root_c,
+                                  feature_mask)
+
+        while True:
+            do, plan = self._wave_begin(state)
+            if not bool(do):          # the one host sync per wave
+                break
+            hist_acc = jnp.zeros((self.wave_width,) + self._hist_shape,
+                                 jnp.float32)
+            leaf_id = state.leaf_id
+            for i, xb_c in pipe.sweep():
+                leaf_id, hist_acc = self._chunk_wave(
+                    xb_c, jnp.int32(i * R), leaf_id, grad, hess,
+                    sample_mask, plan, hist_acc)
+            state = self._wave_commit(state, plan, hist_acc, leaf_id,
+                                      feature_mask)
+
+        self.trees_grown += 1
+        if self.params.obs_modelstats:
+            return state.tree, state.leaf_id, (state.health, state.mstats)
+        return state.tree, state.leaf_id, state.health

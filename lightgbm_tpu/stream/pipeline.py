@@ -1,0 +1,141 @@
+"""Double-buffered host->device chunk transfer.
+
+``jax.device_put`` is asynchronous: it enqueues the copy and returns
+immediately, so issuing the NEXT chunk's transfer before sweeping the
+current chunk's histograms overlaps PCIe/ICI traffic with compute — the
+staging trick of the GPU-GBDT line (arXiv 1706.08359 §4), host-driven.
+The pipeline keeps ``prefetch`` transfers in flight and measures how
+well the overlap works: ``wait_s`` accumulates only the time the sweep
+loop actually blocks on an unfinished copy, so
+
+    overlap_efficiency = 1 - wait_s / total_s
+
+is 1.0 when every transfer finished under the previous sweep and 0.0
+when the loop is pure transfer-bound. Those numbers surface in
+``tools/stream_smoke.py`` and BENCH_r12.
+
+Chunks are repacked host-side to a UNIFORM ``chunk_rows`` row count
+(last chunk zero-padded): every device buffer then has one shape
+[R, C], so the jitted per-chunk kernels compile once regardless of how
+many chunks the dataset has or how ragged the source's chunking was.
+Row ``r`` of uniform chunk ``i`` is global row ``i*R + r``; rows past
+``num_data`` are masked off by the grower's ``row_valid``.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..log import check
+
+
+def repack_uniform(chunks: List[np.ndarray], chunk_rows: int
+                   ) -> Tuple[List[np.ndarray], int]:
+    """Repack ragged uint8 chunks into ``chunk_rows``-row chunks.
+
+    Returns (uniform_chunks, num_rows); every returned chunk has exactly
+    ``chunk_rows`` rows (the last is zero-padded). Works chunk-by-chunk —
+    never concatenates the full matrix.
+    """
+    check(chunk_rows > 0, "chunk_rows should be > 0, got %d" % chunk_rows)
+    ncols = chunks[0].shape[1] if chunks else 0
+    out: List[np.ndarray] = []
+    buf = np.zeros((chunk_rows, ncols), np.uint8)
+    fill = 0
+    total = 0
+    for c in chunks:
+        c = np.asarray(c, np.uint8)
+        total += c.shape[0]
+        pos = 0
+        while pos < c.shape[0]:
+            take = min(chunk_rows - fill, c.shape[0] - pos)
+            buf[fill:fill + take] = c[pos:pos + take]
+            fill += take
+            pos += take
+            if fill == chunk_rows:
+                out.append(buf)
+                buf = np.zeros((chunk_rows, ncols), np.uint8)
+                fill = 0
+    if fill > 0:
+        out.append(buf)          # trailing rows stay zero-padded
+    return out, total
+
+
+class ChunkPipeline:
+    """Prefetching iterator over uniform device-resident bin chunks."""
+
+    def __init__(self, chunks: List[np.ndarray], chunk_rows: int,
+                 prefetch: int = 2, device=None):
+        self.chunk_rows = int(chunk_rows)
+        self.prefetch = max(1, int(prefetch))
+        self.device = device
+        self.host_chunks, self.num_data = repack_uniform(chunks,
+                                                         self.chunk_rows)
+        self.num_chunks = len(self.host_chunks)
+        self.num_cols = self.host_chunks[0].shape[1] if self.host_chunks \
+            else 0
+        self.num_padded = self.num_chunks * self.chunk_rows
+        # valid (unpadded) rows of each uniform chunk
+        self.valid_rows = [
+            min(self.chunk_rows, self.num_data - i * self.chunk_rows)
+            for i in range(self.num_chunks)]
+        # accounting, cumulative across sweeps
+        self.sweeps = 0
+        self.rows_transferred = 0
+        self.wait_s = 0.0
+        self.total_s = 0.0
+
+    def _put(self, i: int):
+        import jax
+        h = self.host_chunks[i]
+        return jax.device_put(h, self.device) if self.device is not None \
+            else jax.device_put(h)
+
+    def sweep(self) -> Iterator[Tuple[int, "object"]]:
+        """Yield (chunk_index, device_chunk) once per chunk, in order,
+        keeping up to ``prefetch`` transfers in flight ahead of the
+        consumer. The consumer should finish its work on a yielded chunk
+        before advancing (the buffer is dropped on the next step)."""
+        t0 = time.perf_counter()
+        inflight: deque = deque()
+        for i in range(min(self.prefetch, self.num_chunks)):
+            inflight.append((i, self._put(i)))
+        while inflight:
+            i, dev = inflight.popleft()
+            tw = time.perf_counter()
+            # the sync IS the measurement: wait_s only accumulates when a
+            # transfer failed to hide under the previous chunk's sweep
+            dev.block_until_ready()  # lgbm-lint: disable=LGL103 overlap probe
+            self.wait_s += time.perf_counter() - tw
+            nxt = i + self.prefetch
+            if nxt < self.num_chunks:
+                inflight.append((nxt, self._put(nxt)))
+            yield i, dev
+            del dev
+        self.sweeps += 1
+        self.rows_transferred += self.num_data
+        self.total_s += time.perf_counter() - t0
+
+    # ------------------------------------------------------------- stats
+    def overlap_efficiency(self) -> float:
+        return 1.0 - self.wait_s / self.total_s if self.total_s > 0 else 1.0
+
+    def ingest_rows_per_sec(self) -> Optional[float]:
+        return self.rows_transferred / self.total_s if self.total_s > 0 \
+            else None
+
+    def stats(self) -> dict:
+        return {
+            "num_chunks": self.num_chunks,
+            "chunk_rows": self.chunk_rows,
+            "prefetch": self.prefetch,
+            "sweeps": self.sweeps,
+            "rows_transferred": self.rows_transferred,
+            "wait_s": self.wait_s,
+            "total_s": self.total_s,
+            "overlap_efficiency": self.overlap_efficiency(),
+            "ingest_rows_per_sec": self.ingest_rows_per_sec(),
+        }

@@ -1,0 +1,176 @@
+"""Two-round sample-based binning over a ``ChunkSource``.
+
+Round 1 streams the source once, sampling up to
+``bin_construct_sample_cnt`` rows; bin mappers and the EFB/packing layout
+come from that sample via ``BinnedDataset.from_matrix`` — the exact code
+path every in-memory dataset takes, so boundaries match
+``from_file_two_round`` bit-for-bit (same RNG stream, same vectorized
+Algorithm R: the fill phase keeps original order, which makes
+sample == full data whenever ``bin_construct_sample_cnt >= n`` — the
+hook the exact-parity tests rely on). Round 2 streams again and
+quantizes each chunk host-side against that layout
+(``from_matrix(reference=proto)``), keeping the uint8 chunks SEPARATE:
+the resulting ``StreamedDataset`` never concatenates them, so peak host
+memory is the quantized chunks (~N*C bytes) plus one float chunk, and
+device memory is bounded by ``pipeline.ChunkPipeline``'s prefetch depth.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.dataset import BinnedDataset, Metadata
+from ..log import Log, LightGBMError
+from .source import ChunkSource
+
+
+class StreamedDataset(BinnedDataset):
+    """A ``BinnedDataset`` whose bin matrix lives as host-side chunks.
+
+    Identical layout metadata (mappers, EFB columns, packing) to the
+    in-memory class; ``X_binned`` stays ``None`` and ``chunks`` holds the
+    ordered uint8 [c_i, C] pieces (sum of c_i == num_data). Everything
+    that needs the matrix resident in one piece — ``save_binary``,
+    subset construction, replay-based rollback — refuses with a clear
+    error instead of silently concatenating.
+    """
+
+    is_streamed = True
+
+    def __init__(self):
+        super().__init__()
+        self.chunks: List[np.ndarray] = []
+
+    @property
+    def chunk_row_counts(self) -> List[int]:
+        return [int(c.shape[0]) for c in self.chunks]
+
+    def data_profile(self):
+        """Per-feature bin-occupancy profile accumulated chunk-by-chunk
+        (parity with the single-shot profile is tested)."""
+        if self._data_profile is None:
+            from ..obs.drift import DataProfile
+            self._data_profile = DataProfile.from_binned_chunks(self)
+        return self._data_profile
+
+    def save_binary(self, path: str) -> None:
+        raise LightGBMError(
+            "save_binary is not supported for streamed datasets "
+            "(data_stream_chunk_rows > 0): the bin matrix is never "
+            "materialized in one piece. Save the raw source instead.")
+
+
+def _systematic_sample(stride: int):
+    """Stateful every-``stride``-th-row picker (deterministic alternative
+    to the reservoir for sorted/grouped data where a uniform reservoir
+    could still be preferred by seed; used when ``sample_stride`` > 0)."""
+    state = {"next": 0, "seen": 0}
+
+    def pick(c: int) -> np.ndarray:
+        lo = state["next"] - state["seen"]
+        idx = np.arange(max(lo, 0), c, stride, dtype=np.int64) \
+            if lo < c else np.empty(0, np.int64)
+        if len(idx):
+            state["next"] = state["seen"] + int(idx[-1]) + stride
+        state["seen"] += c
+        return idx
+
+    return pick
+
+
+def ingest(source: ChunkSource, config,
+           feature_names: Optional[List[str]] = None,
+           categorical_feature=None,
+           sample_stride: int = 0) -> StreamedDataset:
+    """Build a ``StreamedDataset`` from a chunk source (two passes).
+
+    ``sample_stride > 0`` switches round 1 from reservoir sampling to
+    systematic every-k-th-row sampling (capped at
+    ``bin_construct_sample_cnt`` rows, earliest kept).
+    """
+    sample_cnt = int(config.bin_construct_sample_cnt)
+    rng = np.random.RandomState(config.data_random_seed)
+    picker = _systematic_sample(int(sample_stride)) if sample_stride > 0 \
+        else None
+
+    source.reset()
+    sample_rows: list = []
+    labels: list = []
+    n_total = 0
+    n_features = -1
+    n_chunks = 0
+    for Xc, yc in source:
+        Xc = np.asarray(Xc, np.float64)
+        if Xc.ndim != 2:
+            raise LightGBMError(
+                "chunk %d is not 2-D (shape %s)" % (n_chunks, (Xc.shape,)))
+        if n_features < 0:
+            n_features = Xc.shape[1]
+        elif Xc.shape[1] != n_features:
+            raise LightGBMError(
+                "chunk %d has %d features, expected %d — every chunk of a "
+                "streamed source must share one feature space"
+                % (n_chunks, Xc.shape[1], n_features))
+        if yc is not None:
+            labels.append(np.asarray(yc, np.float64).reshape(-1))
+        elif labels:
+            raise LightGBMError(
+                "chunk %d has no label but earlier chunks did" % n_chunks)
+        c = Xc.shape[0]
+        if picker is not None:
+            for i in picker(c):
+                if len(sample_rows) < sample_cnt:
+                    sample_rows.append(Xc[i].copy())
+        else:
+            # vectorized Algorithm R, identical to from_file_two_round
+            # (io/dataset.py): fill in order, then row i draws
+            # j ~ U[0, n_total+i] and replaces slot j when j < sample_cnt
+            fill = max(0, min(sample_cnt - n_total, c))
+            for i in range(fill):
+                sample_rows.append(Xc[i].copy())
+            if fill < c:
+                draws = (rng.random_sample(c - fill)
+                         * (n_total + np.arange(fill, c) + 1)
+                         ).astype(np.int64)
+                hits = np.nonzero(draws < sample_cnt)[0]
+                for i in hits:
+                    sample_rows[draws[i]] = Xc[fill + i].copy()
+        n_total += c
+        n_chunks += 1
+    if n_total == 0:
+        raise LightGBMError("streamed source yielded no rows")
+
+    names = feature_names or source.feature_names
+    proto = BinnedDataset.from_matrix(
+        np.asarray(sample_rows), config,
+        feature_names=names, categorical_feature=categorical_feature)
+
+    source.reset()
+    chunks: List[np.ndarray] = []
+    row = 0
+    for Xc, _yc in source:
+        bc = BinnedDataset.from_matrix(
+            np.asarray(Xc, np.float64), config, reference=proto)
+        chunks.append(np.ascontiguousarray(bc.X_binned))
+        row += Xc.shape[0]
+    if row != n_total:
+        raise LightGBMError(
+            "source is not restartable: round 2 yielded %d rows, round 1 "
+            "saw %d — reset() must rewind to the identical chunk stream"
+            % (row, n_total))
+
+    sd = StreamedDataset()
+    sd.__dict__.update(proto.__dict__)
+    sd.X_binned = None
+    sd._device_cache = {}
+    sd._data_profile = None
+    sd.chunks = chunks
+    sd.num_data = n_total
+    sd.metadata = Metadata(n_total)
+    if labels:
+        sd.metadata.set_label(np.concatenate(labels))
+    Log.info("stream: ingested %d rows in %d chunks (%d stored columns, "
+             "sample=%d rows)", n_total, len(chunks),
+             chunks[0].shape[1] if chunks else 0, len(sample_rows))
+    return sd

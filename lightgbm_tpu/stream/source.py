@@ -1,0 +1,184 @@
+"""Chunked raw-data sources for out-of-core ingest.
+
+A ``ChunkSource`` is the streaming analog of the raw [N, F] matrix every
+in-memory path starts from: a restartable iterator of bounded float
+chunks. ``reset()`` rewinds it so the two-round loader (stream/sampler.py)
+can pass over the data twice — once to sample bin boundaries, once to
+quantize — exactly the contract the reference ``DatasetLoader`` has with
+its text parsers (dataset_loader.cpp:160-219).
+
+Backends:
+
+- ``ArraySource``   — an in-memory dense matrix, sliced row-wise (the
+  degenerate case; exists so every streamed-vs-single-shot parity test
+  can run from identical bits);
+- ``NpyMmapSource`` — a ``.npy`` file opened with ``mmap_mode="r"``:
+  each chunk copies one row-slice out of the OS page cache, so peak
+  resident float memory is one chunk regardless of file size;
+- ``CsvSource``     — delimited text via ``io/parser.parse_file_chunks``
+  (the two-round text front end; LibSVM is rejected up front because a
+  sparse file has no global feature count until fully scanned).
+
+Every backend validates eagerly (shape, dtype-coercibility, label
+length) so a bad source fails at construction or on the first chunk with
+a ``LightGBMError`` naming the problem, never as a shape error deep in
+the binning pass.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..log import LightGBMError, check
+
+# one yielded chunk: (X [c, F] float64, label [c] float64 | None)
+Chunk = Tuple[np.ndarray, Optional[np.ndarray]]
+
+
+class ChunkSource:
+    """Restartable iterator of (X_chunk, label_chunk) pairs.
+
+    Contract: ``reset()`` rewinds to the first chunk; ``__iter__`` then
+    yields every chunk once, in a FIXED order (chunk order is part of
+    the streamed dataset's identity — the checkpoint fingerprint hashes
+    chunks in order). ``chunk_rows`` bounds every chunk's row count;
+    ``feature_names`` may be None until the first chunk has been read.
+    """
+
+    chunk_rows: int = 0
+    feature_names: Optional[List[str]] = None
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Chunk]:
+        raise NotImplementedError
+
+
+def _check_chunk_rows(chunk_rows: int) -> int:
+    chunk_rows = int(chunk_rows)
+    check(chunk_rows > 0,
+          "stream chunk_rows should be > 0, got %d" % chunk_rows)
+    return chunk_rows
+
+
+class ArraySource(ChunkSource):
+    """In-memory dense matrix sliced into row chunks."""
+
+    def __init__(self, data, label=None, chunk_rows: int = 262144):
+        self.chunk_rows = _check_chunk_rows(chunk_rows)
+        if hasattr(data, "tocsr") or hasattr(data, "tocsc"):
+            raise LightGBMError(
+                "streamed ingest does not support sparse input; "
+                "densify or set data_stream_chunk_rows=0")
+        data = np.asarray(data)
+        if data.ndim != 2:
+            raise LightGBMError(
+                "streamed ingest needs 2-D data, got shape %s"
+                % (data.shape,))
+        try:
+            self._X = np.asarray(data, dtype=np.float64)
+        except (TypeError, ValueError) as e:
+            raise LightGBMError(
+                "streamed ingest could not coerce data to float: %s" % e)
+        self._y = None
+        if label is not None:
+            self._y = np.asarray(label, dtype=np.float64).reshape(-1)
+            if len(self._y) != self._X.shape[0]:
+                raise LightGBMError(
+                    "label length %d does not match %d data rows"
+                    % (len(self._y), self._X.shape[0]))
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[Chunk]:
+        n = self._X.shape[0]
+        for start in range(0, n, self.chunk_rows):
+            stop = min(start + self.chunk_rows, n)
+            yield (self._X[start:stop],
+                   self._y[start:stop] if self._y is not None else None)
+
+
+class NpyMmapSource(ChunkSource):
+    """Row chunks out of a memory-mapped ``.npy`` matrix.
+
+    ``np.load(mmap_mode="r")`` keeps the file on disk; each yielded chunk
+    copies one row-slice (so downstream code may hold it without pinning
+    the map). ``label`` is either an in-memory array or a path to a 1-D
+    ``.npy`` of matching length.
+    """
+
+    def __init__(self, path: str, label=None, chunk_rows: int = 262144):
+        self.chunk_rows = _check_chunk_rows(chunk_rows)
+        check(os.path.exists(path), "Data file %s doesn't exist" % path)
+        self.path = path
+        try:
+            mm = np.load(path, mmap_mode="r")
+        except Exception as e:  # noqa: BLE001 - surface as config error
+            raise LightGBMError("could not mmap %s as .npy: %s" % (path, e))
+        if mm.ndim != 2:
+            raise LightGBMError(
+                "%s should hold a 2-D matrix, got shape %s"
+                % (path, mm.shape))
+        self._shape = mm.shape
+        del mm
+        self._y: Optional[np.ndarray] = None
+        if isinstance(label, str):
+            check(os.path.exists(label),
+                  "Label file %s doesn't exist" % label)
+            self._y = np.asarray(np.load(label), np.float64).reshape(-1)
+        elif label is not None:
+            self._y = np.asarray(label, np.float64).reshape(-1)
+        if self._y is not None and len(self._y) != self._shape[0]:
+            raise LightGBMError(
+                "label length %d does not match %d rows of %s"
+                % (len(self._y), self._shape[0], path))
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[Chunk]:
+        mm = np.load(self.path, mmap_mode="r")
+        try:
+            n = mm.shape[0]
+            for start in range(0, n, self.chunk_rows):
+                stop = min(start + self.chunk_rows, n)
+                X = np.array(mm[start:stop], dtype=np.float64)
+                y = self._y[start:stop] if self._y is not None else None
+                yield X, y
+        finally:
+            del mm
+
+
+class CsvSource(ChunkSource):
+    """Delimited text file streamed through ``parser.parse_file_chunks``."""
+
+    def __init__(self, path: str, chunk_rows: int = 262144,
+                 has_header: bool = False, label_column: str = ""):
+        from ..io import parser as parser_mod
+        self.chunk_rows = _check_chunk_rows(chunk_rows)
+        check(os.path.exists(path), "Data file %s doesn't exist" % path)
+        if parser_mod.sniff_libsvm(path):
+            raise LightGBMError(
+                "streamed ingest supports delimited files only; LibSVM "
+                "input needs the one-shot parser "
+                "(data_stream_chunk_rows=0)")
+        self.path = path
+        self.has_header = bool(has_header)
+        self.label_column = str(label_column)
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[Chunk]:
+        from ..io.parser import parse_file_chunks
+        for Xc, yc, names in parse_file_chunks(
+                self.path, has_header=self.has_header,
+                label_column=self.label_column,
+                chunk_rows=self.chunk_rows):
+            if self.feature_names is None and names:
+                self.feature_names = list(names)
+            yield np.asarray(Xc, np.float64), np.asarray(yc, np.float64)

@@ -1,0 +1,405 @@
+"""lightgbm_tpu.stream: out-of-core chunked ingest, binning and training.
+
+The contract under test is docs/OutOfCore.md's headline: because
+histograms (and bin counts) are additive over row partitions, training
+from host-side chunks is STRUCTURE-IDENTICAL to single-shot training at
+the same bin boundaries — same splits, same thresholds, same leaf
+partition — for any chunk size, including a ragged last chunk and the
+chunk_rows >= n degeneracy. Exact-parity cases pin that end-to-end
+(``bin_construct_sample_cnt >= n`` makes round-1 reservoir == full data,
+so the boundaries match the in-memory loader bit-for-bit); the
+additivity property is additionally pinned at the kernel level for every
+histogram impl. Around the core: source error paths, pipeline repacking
+and overlap accounting, streamed checkpoints (fingerprint + resume
+byte-identity), and per-chunk drift-profile parity.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.log import LightGBMError
+
+# structural model-text lines: everything but the float-accumulation-
+# sensitive value lines (split_gain / leaf_value / internal_value differ
+# in the last ulp because chunked f32 sums run in a different order)
+_STRUCT_KEYS = ("split_feature=", "threshold=", "left_child=",
+                "right_child=", "leaf_count=", "internal_count=",
+                "num_leaves=", "decision_type=", "cat_boundaries=",
+                "cat_threshold=", "num_cat=")
+
+
+def _struct(model_str):
+    return [l for l in model_str.splitlines() if l.startswith(_STRUCT_KEYS)]
+
+
+def _data(n=3000, f=8, seed=0, categorical=False):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    if categorical:
+        X[:, 3] = r.randint(0, 8, n)
+    y = (2 * X[:, 0] + np.sin(X[:, 1]) + 0.7 * X[:, 2]
+         + 0.3 * r.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+# sample_cnt >= n: round-1 reservoir keeps all rows in order, so the bin
+# boundaries are IDENTICAL to the in-memory loader's and parity is exact
+_BASE = dict(objective="binary", num_leaves=8, verbosity=-1,
+             tree_growth="frontier", bin_construct_sample_cnt=200000,
+             min_data_in_leaf=5, deterministic=True)
+
+
+def _train(params, X, y, rounds=5, **dskw):
+    return lgb.train(dict(params), lgb.Dataset(X, label=y, **dskw),
+                     num_boost_round=rounds)
+
+
+# ------------------------------------------------- histogram additivity
+@pytest.mark.parametrize("impl", ["matmul", "scatter", "pallas_interpret"])
+def test_histogram_additive_over_chunks(impl):
+    """sum of per-chunk histograms == full-matrix histogram (to fp32
+    accumulation tolerance) for every impl — the property the streamed
+    grower's correctness rests on."""
+    from lightgbm_tpu.core.histogram import build_histogram
+    r = np.random.RandomState(1)
+    n, f, b = 2000, 6, 32
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = np.abs(r.randn(n)).astype(np.float32)
+    m = (r.rand(n) < 0.8).astype(np.float32)
+    full = np.asarray(build_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        num_bins=b, impl=impl))
+    acc = np.zeros_like(full)
+    for lo in range(0, n, 700):               # ragged last chunk (600)
+        hi = min(lo + 700, n)
+        acc += np.asarray(build_histogram(
+            jnp.asarray(xb[lo:hi]), jnp.asarray(g[lo:hi]),
+            jnp.asarray(h[lo:hi]), jnp.asarray(m[lo:hi]),
+            num_bins=b, impl=impl))
+    np.testing.assert_allclose(acc, full, rtol=1e-5, atol=1e-3)
+
+
+@pytest.mark.parametrize("impl", ["matmul", "scatter", "pallas_interpret"])
+def test_frontier_histogram_additive_over_chunks(impl):
+    from lightgbm_tpu.core.histogram import build_histogram_frontier
+    r = np.random.RandomState(2)
+    n, f, b, k = 2000, 6, 32, 4
+    xb = r.randint(0, b, (n, f)).astype(np.uint8)
+    slot = r.randint(-1, k, n).astype(np.int32)
+    g = r.randn(n).astype(np.float32)
+    h = np.abs(r.randn(n)).astype(np.float32)
+    m = (r.rand(n) < 0.8).astype(np.float32)
+    full = np.asarray(build_histogram_frontier(
+        jnp.asarray(xb), jnp.asarray(slot), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(m), num_bins=b, num_slots=k, impl=impl))
+    acc = np.zeros_like(full)
+    for lo in range(0, n, 700):
+        hi = min(lo + 700, n)
+        acc += np.asarray(build_histogram_frontier(
+            jnp.asarray(xb[lo:hi]), jnp.asarray(slot[lo:hi]),
+            jnp.asarray(g[lo:hi]), jnp.asarray(h[lo:hi]),
+            jnp.asarray(m[lo:hi]), num_bins=b, num_slots=k, impl=impl))
+    np.testing.assert_allclose(acc, full, rtol=1e-5, atol=1e-3)
+
+
+# --------------------------------------------- end-to-end structure parity
+def test_streamed_matches_single_shot_dense():
+    X, y = _data()
+    a = _train(_BASE, X, y)
+    b = _train(dict(_BASE, data_stream_chunk_rows=700), X, y)
+    assert _struct(a.model_to_string()) == _struct(b.model_to_string())
+    # and the predictions agree to fp32 accumulation noise
+    np.testing.assert_allclose(a.predict(X[:256]), b.predict(X[:256]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_streamed_matches_single_shot_skewed_last_chunk():
+    X, y = _data()
+    # 3000 % 1999 = 1001: the last chunk is half-empty after repacking
+    a = _train(_BASE, X, y)
+    b = _train(dict(_BASE, data_stream_chunk_rows=1999), X, y)
+    assert _struct(a.model_to_string()) == _struct(b.model_to_string())
+
+
+def test_streamed_chunk_rows_ge_n_degenerates_to_single_chunk():
+    X, y = _data(n=1500)
+    a = _train(_BASE, X, y)
+    b = _train(dict(_BASE, data_stream_chunk_rows=10 ** 6), X, y)
+    assert _struct(a.model_to_string()) == _struct(b.model_to_string())
+    ds = lgb.Dataset(X, label=y,
+                     params=dict(_BASE, data_stream_chunk_rows=10 ** 6))
+    assert len(ds.construct()._binned.chunks) == 1
+
+
+def test_streamed_matches_single_shot_categorical_and_efb():
+    X, y = _data(categorical=True, seed=3)
+    # two sparse exclusive-ish columns make EFB bundling kick in
+    r = np.random.RandomState(4)
+    X[:, 4] = (r.rand(len(X)) < 0.05) * r.randint(1, 5, len(X))
+    X[:, 5] = (r.rand(len(X)) < 0.05) * r.randint(1, 5, len(X))
+    p = dict(_BASE)
+    a = _train(p, X, y, categorical_feature=[3])
+    b = _train(dict(p, data_stream_chunk_rows=777), X, y,
+               categorical_feature=[3])
+    assert _struct(a.model_to_string()) == _struct(b.model_to_string())
+
+
+def test_streamed_multiclass_parity():
+    X, _ = _data(seed=5)
+    r = np.random.RandomState(5)
+    y3 = np.digitize(2 * X[:, 0] + np.sin(X[:, 1]) + 0.3 * r.randn(len(X)),
+                     [-1.0, 1.0]).astype(np.float64)
+    p = dict(_BASE, objective="multiclass", num_class=3)
+    a = _train(p, X, y3, rounds=3)
+    b = _train(dict(p, data_stream_chunk_rows=700), X, y3, rounds=3)
+    assert _struct(a.model_to_string()) == _struct(b.model_to_string())
+
+
+def test_streamed_bagging_goss_parity_with_per_iteration_baseline():
+    """Bagging / GOSS draw their keys from the per-iteration split chain;
+    the fused-block path uses a different (batched) chain, so the
+    baseline pins the per-iteration path via observability=full."""
+    X, y = _data(seed=6)
+    for extra in (dict(boosting="goss"),
+                  dict(bagging_fraction=0.7, bagging_freq=1),
+                  dict(feature_fraction=0.6)):
+        p = dict(_BASE, **extra)
+        a = _train(dict(p, observability="full"), X, y)
+        b = _train(dict(p, data_stream_chunk_rows=750), X, y)
+        assert _struct(a.model_to_string()) == _struct(b.model_to_string())
+
+
+def test_streamed_npy_and_csv_sources_match_array(tmp_path):
+    X, y = _data(n=1200)
+    p = dict(_BASE, data_stream_chunk_rows=500)
+    ref = _train(p, X, y, rounds=3)
+
+    npy = str(tmp_path / "X.npy")
+    np.save(npy, X)
+    b1 = lgb.train(dict(p), lgb.Dataset(npy, label=y, params=dict(p)),
+                   num_boost_round=3)
+    assert _struct(ref.model_to_string()) == _struct(b1.model_to_string())
+
+    csv = str(tmp_path / "d.csv")
+    np.savetxt(csv, np.column_stack([y, X]), delimiter=",", fmt="%.10g")
+    b2 = lgb.train(dict(p), lgb.Dataset(csv, params=dict(p)),
+                   num_boost_round=3)
+    # CSV round-trips through decimal text: boundaries can move by one
+    # ulp, so parity is on predictions, not split structure
+    np.testing.assert_allclose(ref.predict(X[:128]), b2.predict(X[:128]),
+                               rtol=1e-3, atol=1e-3)
+
+
+# ------------------------------------------------------------- ingest unit
+def test_reservoir_sample_matches_two_round_loader():
+    """Same RNG stream as BinnedDataset.from_file_two_round: boundaries
+    from a SUB-sample (sample_cnt < n) must also match the file loader's,
+    not just the trivial sample_cnt >= n case."""
+    from lightgbm_tpu.stream import ArraySource
+    from lightgbm_tpu.stream.sampler import ingest
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    X, y = _data(n=2500, f=4, seed=9)
+    cfg = Config(dict(bin_construct_sample_cnt=400, data_random_seed=11))
+    sd = ingest(ArraySource(X, label=y, chunk_rows=600), cfg)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "x.csv")
+        np.savetxt(path, np.column_stack([y, X]), delimiter=",",
+                   fmt="%.17g")
+        ref = BinnedDataset.from_file_two_round(path, cfg)
+    for m1, m2 in zip(sd.bin_mappers, ref.bin_mappers):
+        assert m1.to_dict() == m2.to_dict()
+
+
+def test_streamed_dataset_shape_and_refusals():
+    from lightgbm_tpu.stream import ArraySource
+    from lightgbm_tpu.stream.sampler import ingest
+    from lightgbm_tpu.config import Config
+    X, y = _data(n=1100, f=4)
+    sd = ingest(ArraySource(X, label=y, chunk_rows=300),
+                Config(dict(bin_construct_sample_cnt=200000)))
+    assert sd.is_streamed and sd.X_binned is None
+    assert sd.chunk_row_counts == [300, 300, 300, 200]
+    assert sd.num_data == 1100
+    with pytest.raises(LightGBMError, match="save_binary"):
+        sd.save_binary("/tmp/nope.bin")
+
+
+def test_libsvm_rejected(tmp_path):
+    from lightgbm_tpu.stream import CsvSource
+    path = str(tmp_path / "d.libsvm")
+    with open(path, "w") as fh:
+        fh.write("1 0:2.5 3:1.2\n0 1:0.5\n")
+    with pytest.raises(LightGBMError, match="LibSVM"):
+        CsvSource(path, chunk_rows=4)
+
+
+def test_bad_sources_raise():
+    from lightgbm_tpu.stream import (ArraySource, ChunkSource, CsvSource,
+                                     NpyMmapSource)
+    from lightgbm_tpu.stream.sampler import ingest
+    from lightgbm_tpu.config import Config
+    cfg = Config(dict(bin_construct_sample_cnt=1000))
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    with pytest.raises(LightGBMError):
+        ArraySource(scipy_sparse.eye(10).tocsr(), chunk_rows=5)
+    with pytest.raises(LightGBMError):
+        ArraySource(np.zeros((10, 2)), chunk_rows=0)
+    with pytest.raises(LightGBMError):
+        ArraySource(np.zeros((10, 2)), label=np.zeros(7), chunk_rows=5)
+    with pytest.raises((LightGBMError, IOError, ValueError)):
+        NpyMmapSource("/nonexistent/path.npy", chunk_rows=5)
+
+    class Ragged(ChunkSource):
+        chunk_rows = 4
+
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            yield np.zeros((4, 3)), None
+            yield np.zeros((4, 2)), None      # feature count changes
+
+    with pytest.raises(LightGBMError, match="feature"):
+        ingest(Ragged(), cfg)
+
+    class Empty(ChunkSource):
+        chunk_rows = 4
+
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            return iter(())
+
+    with pytest.raises(LightGBMError, match="no rows"):
+        ingest(Empty(), cfg)
+
+    class Shrinking(ChunkSource):
+        """Non-restartable: round 2 yields fewer rows than round 1."""
+        chunk_rows = 4
+
+        def __init__(self):
+            self.calls = 0
+
+        def reset(self):
+            self.calls += 1
+
+        def __iter__(self):
+            for _ in range(3 if self.calls <= 1 else 2):
+                yield np.random.RandomState(0).randn(4, 3), None
+
+    with pytest.raises(LightGBMError, match="restartable"):
+        ingest(Shrinking(), cfg)
+
+
+def test_streaming_config_gates():
+    X, y = _data(n=400)
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(_BASE, data_stream_chunk_rows=-1),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(_BASE, data_stream_chunk_rows=100,
+                       data_stream_prefetch=0),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(_BASE, data_stream_chunk_rows=100,
+                       tree_growth="exact"),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(_BASE, data_stream_chunk_rows=100, boosting="dart"),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+def test_streamed_rollback_and_input_grads_refused():
+    X, y = _data(n=600)
+    p = dict(_BASE, data_stream_chunk_rows=200)
+    bst = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=2)
+    with pytest.raises(LightGBMError, match="rollback"):
+        bst._impl.rollback_one_iter()
+    with pytest.raises(LightGBMError, match="gradients"):
+        bst._impl.train_one_iter(grad=np.zeros(len(y), np.float32),
+                                 hess=np.ones(len(y), np.float32))
+
+
+# ------------------------------------------------------------- pipeline
+def test_repack_uniform_and_pipeline_accounting():
+    from lightgbm_tpu.stream.pipeline import ChunkPipeline, repack_uniform
+    chunks = [np.arange(i * 10, i * 10 + r * 3, dtype=np.uint8
+                        ).reshape(r, 3) % 250
+              for i, r in enumerate([5, 2, 7, 1])]
+    uni, total = repack_uniform(chunks, 4)
+    assert total == 15
+    assert [c.shape for c in uni] == [(4, 3)] * 4
+    flat = np.concatenate(uni)[:total]
+    np.testing.assert_array_equal(flat, np.concatenate(chunks))
+    assert not np.any(np.concatenate(uni)[total:])   # zero padding
+
+    pipe = ChunkPipeline(chunks, 4, prefetch=2)
+    assert pipe.num_chunks == 4 and pipe.num_padded == 16
+    assert pipe.valid_rows == [4, 4, 4, 3]
+    seen = [(i, np.asarray(c)) for i, c in pipe.sweep()]
+    assert [i for i, _ in seen] == [0, 1, 2, 3]
+    np.testing.assert_array_equal(np.concatenate([c for _, c in seen]),
+                                  np.concatenate(uni))
+    st = pipe.stats()
+    assert st["sweeps"] == 1 and st["rows_transferred"] == 15
+    assert 0.0 <= st["overlap_efficiency"] <= 1.0
+
+
+# ----------------------------------------------------- checkpoint / drift
+def test_streamed_fingerprint_semantics():
+    from lightgbm_tpu.checkpoint.snapshot import dataset_fingerprint
+    X, y = _data(n=900, f=4)
+    mk = lambda params: lgb.Dataset(X, label=y, params=params) \
+        .construct()._binned
+    d1 = mk(dict(_BASE, data_stream_chunk_rows=250))
+    d2 = mk(dict(_BASE, data_stream_chunk_rows=400))
+    d3 = lgb.Dataset(X + 1e-3, label=y,
+                     params=dict(_BASE, data_stream_chunk_rows=250)) \
+        .construct()._binned
+    # chunking-invariant (same rows, same layout), data-sensitive
+    assert dataset_fingerprint(d1) == dataset_fingerprint(d2)
+    assert dataset_fingerprint(d1) != dataset_fingerprint(d3)
+
+
+def test_streamed_resume_byte_identical(tmp_path):
+    from lightgbm_tpu import callback, engine
+    X, y = _data(n=1500)
+    p = dict(_BASE, data_stream_chunk_rows=400, bagging_fraction=0.8,
+             bagging_freq=1)
+
+    def run(ckpt, rounds, resume=False):
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+        return engine.train(dict(p), ds, num_boost_round=rounds,
+                            callbacks=[callback.checkpoint(ckpt, period=1)],
+                            resume_from=(ckpt if resume else None),
+                            verbose_eval=False)
+
+    golden = run(str(tmp_path / "g"), 6)
+    run(str(tmp_path / "i"), 2)
+    resumed = run(str(tmp_path / "i"), 6, resume=True)
+    assert golden.model_to_string() == resumed.model_to_string()
+
+
+def test_streamed_drift_profile_matches_single_shot():
+    from lightgbm_tpu.obs.drift import DataProfile
+    X, y = _data(n=1300, seed=8, categorical=True)
+    r = np.random.RandomState(8)
+    X[:, 4] = (r.rand(len(X)) < 0.05) * r.randint(1, 5, len(X))
+    X[:, 5] = (r.rand(len(X)) < 0.05) * r.randint(1, 5, len(X))
+    full = lgb.Dataset(X, label=y, categorical_feature=[3],
+                       params=dict(_BASE)).construct()._binned
+    streamed = lgb.Dataset(X, label=y, categorical_feature=[3],
+                           params=dict(_BASE, data_stream_chunk_rows=300)) \
+        .construct()._binned
+    a = DataProfile.from_binned_dataset(full)
+    b = streamed.data_profile()
+    assert a.num_data == b.num_data
+    assert a.features == b.features      # bit-identical counts + mappers
