@@ -94,7 +94,9 @@ def collect_audit(workload: Optional[Dict[str, Any]] = None
     from .. import bucketing
     from ..core.grow_frontier import wave_hist_entry
     params = b.grow_params
-    n, ncols = b.xb.shape
+    n = b.xb.shape[0]
+    # stored-column count, not the word-matrix width (core/binpack.py)
+    ncols = params.word_packed_cols or b.xb.shape[1]
     for w in bucketing.wave_width_ladder(params.num_leaves,
                                          params.max_depth):
         fn, hargs, hkw = wave_hist_entry(n, ncols, b.xb.dtype, params, w)
@@ -110,7 +112,7 @@ def collect_audit(workload: Optional[Dict[str, Any]] = None
 
     # ---- unsharded grower (the PR 6 "byte-identical grower" compare)
     from ..core.grow_frontier import grow_tree_frontier
-    f = b.xb.shape[1]
+    f = params.word_packed_cols or b.xb.shape[1]
     fmask = jnp.ones((f,), bool)
     entries["grower"] = jaxpr_audit.audit_jaxpr(jax.make_jaxpr(
         lambda xb, g, h, m: grow_tree_frontier(

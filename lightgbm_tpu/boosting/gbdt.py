@@ -303,12 +303,23 @@ class GBDT:
                 raise LightGBMError(
                     "streamed training accumulates f32 wave histograms; "
                     "set gpu_use_dp=false")
+            from ..core.binpack import resolve_bin_packing
             from ..stream.pipeline import ChunkPipeline
             chunk_cap = int(cfg.data_stream_chunk_rows) or \
                 max(1, max(ds.chunk_row_counts))
+            # packed host chunks (core/binpack.py): word-pack at repack
+            # time so every host->device transfer ships the kernel-native
+            # int32-word layout; under tpu_bin_packing=nibble the DATASET
+            # pair coding already halved the stored columns, so the
+            # per-row transfer bytes halve with it
+            stream_packed = resolve_bin_packing(
+                cfg.tpu_bin_packing, streamed=True,
+                tpu_shaped=partition_mod.tpu_shaped_backend(),
+                col_num_bin=list(ds.col_num_bin)) != "none"
             self._stream = ChunkPipeline(
                 ds.chunks, chunk_cap,
-                prefetch=int(cfg.data_stream_prefetch))
+                prefetch=int(cfg.data_stream_prefetch),
+                packed=stream_packed)
             pad = self._stream.num_padded - ds.num_data
             if pad:
                 row_valid = np.concatenate(
@@ -510,6 +521,25 @@ class GBDT:
         # resolved once: _resolve_hist_impl logs a user-facing warning on
         # the f64-routes-off-pallas path, which must not repeat per call
         hist_impl = _resolve_hist_impl(cfg)
+        # packed-bin device matrix (core/binpack.py): the int32-word
+        # layout rides the frontier grower on single-device in-memory
+        # runs — mesh learners shard the feature axis of the plain
+        # matrix, and streamed chunks pack per-chunk in the pipeline.
+        # nibble vs byte only matters at the DATASET level (pair
+        # coding); on device both store 8-bit codes 4-per-word, so the
+        # decision here is solely mode != "none".
+        word_packed_cols = 0
+        if streamed:
+            if self._stream.packed:
+                word_packed_cols = int(self._stream.num_cols)
+        elif frontier_mode and self.mesh is None:
+            from ..core.binpack import resolve_bin_packing
+            pack_mode = resolve_bin_packing(
+                cfg.tpu_bin_packing, streamed=False,
+                tpu_shaped=partition_mod.tpu_shaped_backend(),
+                col_num_bin=list(ds.col_num_bin))
+            if pack_mode != "none":
+                word_packed_cols = int(xb_np.shape[1])
         self.grow_params = GrowParams(
             num_leaves=cfg.num_leaves,
             num_bins=self.num_bins,
@@ -557,16 +587,21 @@ class GBDT:
             # tpu_frontier_rs + f32 histograms (and columns padded to the
             # axis size, which _frontier_rs guaranteed above)
             frontier_rs=(frontier_mode and self._frontier_rs),
-            # wave-width bucketing: off under vmapped multiclass growth —
-            # vmap lowers the width switch to execute-ALL-branches, which
-            # costs ~2x the fixed-width wave instead of saving it. Also
-            # off when streaming: a ladder would multiply the per-chunk
+            # wave-width bucketing: single-device vmapped multiclass now
+            # routes to grow_tree_frontier_classes, which hoists the
+            # width switch OUTSIDE the vmap (an unbatched branch index),
+            # so bucketing stays on there; it remains off for vmapped
+            # growth over a mesh, where vmapping the shard_map'd grower
+            # would lower the switch to execute-ALL-branches. Also off
+            # when streaming: a ladder would multiply the per-chunk
             # kernel set by its length and make the compiled-program
             # count depend on which widths a run visits (the perf gate
             # pins that count invariant in chunk count)
-            frontier_bucketing=(frontier_mode and not vmapped
+            frontier_bucketing=(frontier_mode
+                                and not (vmapped and self.mesh is not None)
                                 and not streamed
                                 and bool(cfg.tpu_frontier_bucketing)),
+            word_packed_cols=word_packed_cols,
             with_efb=ds.has_bundles or ds.has_packed,
             num_feat_bins=self.num_feat_bins,
             # single source of truth: the marginalization width IS the
@@ -590,6 +625,20 @@ class GBDT:
             # back to host-side recomputation at materialize
             obs_modelstats=(frontier_mode and not self._partition_on_mesh
                             and bool(cfg.obs_modelstats)))
+
+        self._word_packed_cols = word_packed_cols
+        if word_packed_cols and not streamed:
+            # replace the device matrix with its packed words NOW — the
+            # uint8 copy was never materialized on device (self.xb above
+            # is only committed lazily by jnp.asarray at first use on
+            # CPU backends; repacking from the host array keeps this a
+            # single transfer of the halved/word layout)
+            from ..core.binpack import pack_words_np
+            self.xb = jnp.asarray(pack_words_np(xb_np))
+            Log.info("bin packing: %d uint8 columns stored as %d int32 "
+                     "words/row on device (tpu_bin_packing=%s)",
+                     word_packed_cols, self.xb.shape[1],
+                     cfg.tpu_bin_packing)
 
         if streamed:
             if not frontier_mode:
@@ -1094,8 +1143,22 @@ class GBDT:
                 cegb_out = (jax.tree.map(lambda a: a[None], cb1)
                             if cb1 is not None else None)
             elif params.vmapped_classes:
-                trees, leaf_ids, cegb_out = jax.vmap(
-                    grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
+                if params.frontier_mode and fp_capture is None \
+                        and not params.partition_on_mesh \
+                        and params.voting_top_k == 0:
+                    # class-batched frontier growth with the wave-width
+                    # switch OUTSIDE the vmap (grow_frontier.py): the
+                    # branch index is an unbatched max-live scalar, so
+                    # bucketing dispatches ONE ladder branch per wave
+                    # instead of vmap's execute-all-branches lowering
+                    from ..core.grow_frontier import \
+                        grow_tree_frontier_classes
+                    trees, leaf_ids, cegb_out = grow_tree_frontier_classes(
+                        xb, g.T, h.T, sample_mask, meta, feature_mask,
+                        params)
+                else:
+                    trees, leaf_ids, cegb_out = jax.vmap(
+                        grow_one, in_axes=(1, 1, None))(g, h, cegb_state)
             else:
                 trees, leaf_ids, cegb_out = lax.map(
                     lambda gh: grow_one(gh[0], gh[1], cegb_state),
@@ -1522,7 +1585,8 @@ class GBDT:
             jax.block_until_ready(build_histogram_frontier(
                 self.xb, slot, g, h, mask, num_bins=params.num_bins,
                 num_slots=w, row_chunk=params.row_chunk,
-                impl=params.hist_impl))
+                impl=params.hist_impl,
+                packed_cols=params.word_packed_cols))
             per_bucket[w] = backend_compile_count() - c0
         after = compile_cache_stats()
         return {
@@ -1584,18 +1648,34 @@ class GBDT:
             # mesh growth lowers inside shard_map on shard-local shapes;
             # the standalone global-shape entry would not price it
             from .. import bucketing
-            from ..core.grow_frontier import wave_hist_entry
+            from ..core.grow_frontier import (wave_fused_entry,
+                                              wave_hist_entry)
             widths = (bucketing.wave_width_ladder(params.num_leaves,
                                                   params.max_depth)
                       if params.frontier_bucketing
                       else [bucketing.frontier_max_width(
                           params.num_leaves, params.max_depth)])
-            n, ncols = self.xb.shape
+            n = self.xb.shape[0]
+            # real stored-column count, not the word-matrix width: the
+            # packed entry's SDS mirror derives its own word shape
+            ncols = params.word_packed_cols or self.xb.shape[1]
+            fmask = jnp.ones((ncols,), bool)
             for w in widths:
                 hfn, hargs, hkw = wave_hist_entry(
                     n, ncols, self.xb.dtype, params, w)
                 name = "frontier_hist_w%d" % w
                 out[name] = cm.analyze(name, hfn, *hargs, **hkw)
+                # the whole fused wave region (hist -> sibling subtract
+                # -> expand/fix -> 2K-child bin scan): unlike the sweep
+                # alone — whose scatter update traffic is structurally
+                # width-invariant (updates are [n, C, 3] whatever kw) —
+                # this entry's flops/bytes genuinely scale with kw, so
+                # per-bucket costs are distinguishable in the gate
+                ffn, fargs, fkw = wave_fused_entry(
+                    n, ncols, self.xb.dtype, self.feature_meta, fmask,
+                    params, w)
+                name = "frontier_wave_w%d" % w
+                out[name] = cm.analyze(name, ffn, *fargs, **fkw)
         if self._stream is not None:
             # streamed growth: one fixed-width per-chunk sweep is the
             # whole kernel story — price it at the pipeline's chunk shape
@@ -2133,18 +2213,27 @@ class GBDT:
             cache["scores"] = scores
 
     @staticmethod
-    @functools.partial(jax.jit, static_argnames=())
+    @functools.partial(jax.jit, static_argnames=("packed",))
     def _replay_leaves_binned_impl(split_leaf, stored_col, bin_offset,
                                    threshold_bin, default_left, missing_type,
                                    is_cat, cat_bitset, num_bin, default_bin,
-                                   pack_div, pack_mod, xb):
+                                   pack_div, pack_mod, xb, packed=False):
         from ..core.grow import _bin_go_left, decode_bundle_value
         n = xb.shape[0]
         num_nodes = split_leaf.shape[0]
 
         def step(t, leaf_id):
             active = split_leaf[t] >= 0
-            col = jnp.take(xb, stored_col[t], axis=1)
+            if packed:
+                # word-packed device matrix (core/binpack.py): extract
+                # the split's single code column with a shift/mask
+                from ..core.binpack import CODES_PER_WORD
+                word = jnp.take(xb, stored_col[t] // CODES_PER_WORD,
+                                axis=1)
+                col = (word >> ((stored_col[t] % CODES_PER_WORD) * 8)) \
+                    & 0xFF
+            else:
+                col = jnp.take(xb, stored_col[t], axis=1)
             binv = decode_bundle_value(col, bin_offset[t], num_bin[t],
                                        default_bin[t],
                                        pack_div=pack_div[t],
@@ -2167,6 +2256,10 @@ class GBDT:
                             for f in ht.split_feature], np.int32)
         default_bin = np.array([ds.bin_mappers[int(f)].default_bin
                                 for f in ht.split_feature], np.int32)
+        # the train matrix may be word-packed (int32 words); the valid
+        # caches always hold plain uint8 columns
+        packed = (getattr(self, "_word_packed_cols", 0) > 0
+                  and xb.dtype == jnp.int32)
         return self._replay_leaves_binned_impl(
             jnp.asarray(ht.split_leaf), jnp.asarray(feat_col[inner]),
             jnp.asarray(feat_offset[inner]),
@@ -2174,7 +2267,7 @@ class GBDT:
             jnp.asarray(ht.missing_type), jnp.asarray(ht.is_categorical),
             jnp.asarray(ht.cat_bitset_bin), jnp.asarray(num_bin),
             jnp.asarray(default_bin), jnp.asarray(pack_div[inner]),
-            jnp.asarray(pack_mod[inner]), xb)
+            jnp.asarray(pack_mod[inner]), xb, packed=packed)
 
     # ------------------------------------------------------------ evaluation
     def get_eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:

@@ -177,6 +177,18 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     # scatter | pallas_interpret; f64 mode routes off the f32-only pallas
     # — the GPUTreeLearner device-path dispatch analog (tree_learner.cpp:9-31)
     ("tpu_hist_impl", str, "auto", []),
+    # device bin-matrix packing (core/binpack.py; docs/Performance.md
+    # "Packed bins & fused wave"): none = uint8 [N,C] columns on device;
+    # byte = the same 8-bit codes packed 4-per-int32 word (lane-friendly
+    # unpack inside each histogram impl; bitwise-identical trees);
+    # nibble = byte packing PLUS pair-coding every two <=16-bin features
+    # into one joint 8-bit column (extends enable_nbit_packing's cap from
+    # max_bin to 256) — halves stored columns, host->device transfer, and
+    # histogram scatter traffic (>=1.5x costmodel bytes), trees
+    # structure-identical to unpacked. auto = none in-memory on CPU,
+    # byte for streamed ingest, nibble on TPU-shaped backends when every
+    # candidate feature fits 16 bins (byte otherwise).
+    ("tpu_bin_packing", str, "auto", ["bin_packing"]),
     ("tpu_donate_buffers", bool, True, []),   # donate score/state buffers under jit
     ("mesh_shape", list, [], []),             # e.g. [8] / [4,2]; empty = all devices on one axis
     # growth strategy: exact = reference leaf-wise best-first; batched =
@@ -360,6 +372,7 @@ HEALTH_MONITOR_ACTIONS = ("auto", "none", "warn", "abort", "raise")
 OBS_DISTRIBUTED_MODES = ("auto", "on", "off")
 HIST_IMPLS = ("auto", "matmul", "scatter", "pallas", "pallas_highest",
               "pallas_interpret", "pallas_highest_interpret")
+BIN_PACKING_MODES = ("auto", "none", "nibble", "byte")
 
 _CANON: Dict[str, Tuple[type, Any]] = {n: (t, d) for n, t, d, _ in _PARAMS}
 _ALIASES: Dict[str, str] = {}
@@ -544,6 +557,11 @@ class Config:
             raise LightGBMError("tpu_hist_impl should be one of %s, got %s"
                                 % ("/".join(HIST_IMPLS),
                                    self.tpu_hist_impl))
+        self.tpu_bin_packing = str(self.tpu_bin_packing).strip().lower()
+        if self.tpu_bin_packing not in BIN_PACKING_MODES:
+            raise LightGBMError("tpu_bin_packing should be one of %s, got %s"
+                                % ("/".join(BIN_PACKING_MODES),
+                                   self.tpu_bin_packing))
         if self.tree_batch_splits < 1:
             raise LightGBMError("tree_batch_splits should be >= 1")
         self.tpu_batched_part = str(self.tpu_batched_part).strip().lower()

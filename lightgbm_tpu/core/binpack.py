@@ -1,0 +1,117 @@
+"""Bit-packed device bin matrix (tpu_bin_packing; docs/Performance.md).
+
+The reference keeps 4-bit bins two-per-byte in ``Dense4bitsBin``
+(dense_nbits_bin.hpp); the TPU-native analog is split across two layers:
+
+- **pair coding** (io/dataset.py ``_pack_small_pairs``): two <=16-bin
+  features share one stored 8-bit column, ``code = bin_a * nb_b + bin_b``
+  — the real "two bins per byte". ``tpu_bin_packing=nibble`` raises the
+  joint-code cap from ``max_bin`` to 256 so pairing engages dataset-wide,
+  halving stored columns and every byte of downstream histogram traffic.
+- **word packing** (this module): whatever 8-bit columns the dataset
+  produced are stored on device 4-codes-per-int32 word. Mosaic has no
+  uint8 casts, so the int32-word layout is the Pallas-kernel-native one;
+  matmul/scatter impls unpack lanes inside the jitted region (a shift/
+  mask, never a second device copy of the unpacked matrix).
+
+Codes are always 8 bits — a 4-bit word field buys nothing (XLA's cost
+model floors scatter traffic at the f32 updates + i32 indices, and pair
+codes need the full byte), so there is exactly ONE word format for both
+``byte`` and ``nibble`` modes; the modes differ only at the dataset
+level. All helpers here are layout-pure: pack -> unpack round-trips
+bit-exactly for any column count (tail lanes zero-padded).
+"""
+from typing import Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# int32 words hold 4 eight-bit bin codes, little-endian lanes:
+# word = c0 | c1 << 8 | c2 << 16 | c3 << 24
+CODES_PER_WORD = 4
+_LANE_BITS = 8
+_LANE_MASK = 0xFF
+
+
+def words_per_row(num_cols: int) -> int:
+    """Packed word-matrix columns for ``num_cols`` 8-bit code columns."""
+    return (int(num_cols) + CODES_PER_WORD - 1) // CODES_PER_WORD
+
+
+def pack_words_np(xb: np.ndarray) -> np.ndarray:
+    """Host-side pack: uint8 [N, C] -> int32 [N, ceil(C/4)] words.
+
+    Tail lanes of the last word are zero (bin 0 is always a valid code,
+    and no consumer addresses columns >= C, so the padding is inert).
+    """
+    xb = np.ascontiguousarray(xb, dtype=np.uint8)
+    if xb.ndim != 2:
+        raise ValueError("pack_words_np expects [N, C], got %s" % (xb.shape,))
+    n, c = xb.shape
+    w = words_per_row(c)
+    padded = np.zeros((n, w * CODES_PER_WORD), dtype=np.uint8)
+    padded[:, :c] = xb
+    # little-endian uint8 lanes ARE the int32 word layout; a view avoids
+    # per-lane shift loops on the host
+    return padded.reshape(n, w, CODES_PER_WORD).view(np.uint32)[
+        :, :, 0].astype(np.int32)
+
+
+def unpack_words(xw: jnp.ndarray, num_cols: int,
+                 dtype=jnp.uint8) -> jnp.ndarray:
+    """Traceable inverse: int32 [N, W] words -> [N, num_cols] codes.
+
+    Arithmetic right shift is fine — the & 0xFF strips any sign fill.
+    ``dtype=jnp.int32`` skips the narrowing cast for consumers that want
+    the lanes kernel-native (Mosaic has no uint8 casts).
+    """
+    cols = jnp.arange(num_cols, dtype=jnp.int32)
+    w = xw[:, cols // CODES_PER_WORD]
+    out = (w >> ((cols % CODES_PER_WORD) * _LANE_BITS)) & _LANE_MASK
+    return out if dtype == jnp.int32 else out.astype(dtype)
+
+
+def unpack_words_np(xw: np.ndarray, num_cols: int) -> np.ndarray:
+    """Host-side inverse of :func:`pack_words_np` (tests, debugging)."""
+    xw = np.ascontiguousarray(xw, dtype=np.int32)
+    lanes = xw.view(np.uint8).reshape(xw.shape[0], -1)
+    return lanes[:, :num_cols].copy()
+
+
+def gather_code_columns(xw: jnp.ndarray, cols: jnp.ndarray) -> jnp.ndarray:
+    """Gather selected code columns straight out of the packed words.
+
+    ``cols`` is int32 [K] (or [N] for a per-row column choice, in which
+    case xw rows and cols align); returns the 8-bit codes as int32
+    without materializing the full unpacked matrix — the routing path's
+    replacement for ``jnp.take_along_axis`` on an unpacked ``xb``.
+    """
+    word = jnp.take_along_axis(
+        xw, (cols // CODES_PER_WORD).reshape(xw.shape[0], -1), axis=1)
+    shift = ((cols % CODES_PER_WORD) * _LANE_BITS).reshape(xw.shape[0], -1)
+    out = (word >> shift) & _LANE_MASK
+    return out.reshape(cols.shape)
+
+
+def resolve_bin_packing(mode: str, *, streamed: bool, tpu_shaped: bool,
+                        col_num_bin: Sequence[int]) -> str:
+    """Resolve tpu_bin_packing=auto to a concrete mode.
+
+    auto policy: plain uint8 columns for the in-memory CPU path (word
+    unpack adds shift/mask work the cost model charges for with no
+    bandwidth to win back), ``byte`` for streamed ingest (words halve
+    nothing by themselves but keep host chunks in the kernel-native
+    layout), ``nibble`` on TPU-shaped backends — falling back to ``byte``
+    when some candidate feature needs more than 16 bins (pair coding
+    only engages for <=16-bin features).
+    """
+    mode = str(mode).strip().lower()
+    if mode != "auto":
+        return mode
+    all_small = all(int(b) <= 16 for b in col_num_bin) if col_num_bin else False
+    if tpu_shaped:
+        return "nibble" if all_small else "byte"
+    if streamed:
+        return "byte"
+    return "none"

@@ -89,6 +89,13 @@ class GrowParams(NamedTuple):
     # and the static tuple of packed inner-feature indices
     pack_j: int = 1
     packed_features: tuple = ()
+    # word-packed device bin matrix (tpu_bin_packing, core/binpack.py):
+    # the REAL stored-column count C when xb arrives as int32 words
+    # holding 4 eight-bit codes each ([N, ceil(C/4)]); 0 = xb is the
+    # plain [N, C] uint8 matrix. Unpack happens inside each histogram
+    # impl and routing gathers codes straight from the words — the
+    # unpacked matrix never exists on device. Frontier growth only.
+    word_packed_cols: int = 0
     # forced splits (serial_tree_learner.cpp ForceSplits :593-751): the
     # first `num_forced` loop steps split a BFS-predetermined (leaf,
     # feature, threshold) instead of the best-gain candidate

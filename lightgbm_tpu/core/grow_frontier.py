@@ -71,6 +71,7 @@ from ..bucketing import frontier_max_width, wave_width_ladder
 from ..compat import pcast
 from ..obs.modelstats import init_mstats, update_mstats
 from ..parallel.learners import make_frontier_learner
+from .binpack import CODES_PER_WORD, words_per_row
 from .histogram import build_histogram, build_histogram_frontier
 from .grow import (GrowParams, TreeArrays, _bin_go_left, _empty_best,
                    decode_bundle_value, empty_tree, expand_hist)
@@ -78,6 +79,16 @@ from .grow_batched import (_drop_set, apply_split_wave, interleave_lr,
                            scatter_child_best)
 from .split import (FeatureMeta, K_MIN_SCORE, calculate_leaf_output,
                     find_best_split)
+
+
+def _xb_sds(n: int, xb_cols: int, xb_dtype, params: GrowParams):
+    """ShapeDtypeStruct mirror of the grower's bin-matrix operand:
+    int32 packed words when the params say the device matrix is
+    word-packed (core/binpack.py), the plain [N, C] matrix otherwise."""
+    if params.word_packed_cols:
+        return jax.ShapeDtypeStruct(
+            (n, words_per_row(params.word_packed_cols)), jnp.int32)
+    return jax.ShapeDtypeStruct((n, xb_cols), jnp.dtype(xb_dtype))
 
 
 def wave_hist_entry(n: int, xb_cols: int, xb_dtype, params: GrowParams,
@@ -91,14 +102,79 @@ def wave_hist_entry(n: int, xb_cols: int, xb_dtype, params: GrowParams,
     obs cost model and the perf gate price wave buckets through this one
     definition and can never drift from the grower's actual kernel."""
     sds = jax.ShapeDtypeStruct
-    args = (sds((n, xb_cols), jnp.dtype(xb_dtype)),
+    args = (_xb_sds(n, xb_cols, xb_dtype, params),
             sds((n,), jnp.int32),          # slot: wave rank or -1
             sds((n,), jnp.float32),        # grad
             sds((n,), jnp.float32),        # hess
             sds((n,), jnp.float32))        # sample mask
     kwargs = dict(num_bins=params.num_bins, num_slots=int(kw),
-                  row_chunk=params.row_chunk, impl=params.hist_impl)
+                  row_chunk=params.row_chunk, impl=params.hist_impl,
+                  packed_cols=params.word_packed_cols)
     return build_histogram_frontier, args, kwargs
+
+
+def derive_child_hists(parent_hist, hist_small, left_small, kw: int):
+    """Sibling-subtraction step shared by the wave commit and the fused
+    pricing entry: [kw, C, B, 3] smaller-child sweep + pooled parents ->
+    the interleaved [2*kw, C, B, 3] (left, right) child tensor."""
+    hist_large = parent_hist - hist_small
+    ls = left_small[:, None, None, None]
+    hist_left = jnp.where(ls, hist_small, hist_large)
+    hist_right = jnp.where(ls, hist_large, hist_small)
+    ch_hist = jnp.stack([hist_left, hist_right],
+                        axis=1).reshape((2 * kw,) + hist_left.shape[1:])
+    return hist_left, hist_right, ch_hist
+
+
+def wave_fused_entry(n: int, xb_cols: int, xb_dtype, meta: FeatureMeta,
+                     feature_mask, params: GrowParams, kw: int):
+    """The ENTIRE fused wave region — histogram sweep -> sibling
+    subtraction -> expand/fix -> 2K-child bin-scan best split — as one
+    AOT-lowerable entry: ``(fn, args, kwargs)`` with ShapeDtypeStruct
+    args, same contract as :func:`wave_hist_entry`.
+
+    This is the pricing seam of the fused pipeline (serial schedule): it
+    composes the same building blocks the wave step runs
+    (``build_histogram_frontier``, :func:`derive_child_hists`,
+    ``expand_hist`` + ``find_best_split``), so the [kw, C, B, 3] wave
+    histogram is an internal value of ONE compiled region — never a
+    separate dispatch output — and the per-bucket cost entries
+    (``frontier_wave_w*``) price work that genuinely scales with the
+    wave width (the bin scan and subtraction are O(kw * C * B), unlike
+    the scatter sweep whose update traffic is width-invariant)."""
+    ncols = params.word_packed_cols or xb_cols
+    sds = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    hshape = (kw, ncols, params.num_bins, 3)
+    args = (_xb_sds(n, xb_cols, xb_dtype, params),
+            sds((n,), jnp.int32),           # slot
+            sds((n,), f32), sds((n,), f32), sds((n,), f32),
+            sds(hshape, f32),               # pooled parent histograms
+            sds((kw,), jnp.bool_),          # left_small
+            sds((2 * kw,), f32), sds((2 * kw,), f32),   # child g/h sums
+            sds((2 * kw,), f32),            # child counts
+            sds((2 * kw,), f32), sds((2 * kw,), f32))   # monotone bounds
+
+    def fused(xb, slot, grad, hess, mask, parent_hist, left_small,
+              ch_sg, ch_sh, ch_cnt, ch_min, ch_max):
+        hist_small = build_histogram_frontier(
+            xb, slot, grad, hess, mask, num_bins=params.num_bins,
+            num_slots=kw, row_chunk=params.row_chunk,
+            impl=params.hist_impl, packed_cols=params.word_packed_cols)
+        _, _, ch_hist = derive_child_hists(parent_hist, hist_small,
+                                           left_small, kw)
+
+        def one(hc, sg, sh, cnt, mn, mx):
+            return find_best_split(
+                expand_hist(hc, sg, sh, cnt, meta, params, ncols),
+                meta, params.split, sg, sh, cnt, feature_mask,
+                min_constraint=mn, max_constraint=mx,
+                with_categorical=params.with_categorical)
+
+        return jax.vmap(one)(ch_hist, ch_sg, ch_sh, ch_cnt, ch_min,
+                             ch_max)
+
+    return jax.jit(fused), args, {}
 
 
 class _FrontierState(NamedTuple):
@@ -123,18 +199,28 @@ def _gain_anomaly(gain: jnp.ndarray) -> jnp.ndarray:
     return jnp.isnan(gain) | (gain == jnp.inf)
 
 
-def _route_rows_gather(xb, rs, cur, meta, with_efb, with_categorical):
+def _route_rows_gather(xb, rs, cur, meta, with_efb, with_categorical,
+                       packed_cols: int = 0):
     """Per-row go-left decisions for the wave's splits via per-row
     gathers of each row's split descriptor (see module docstring for why
     this is gather-based where route_split_rows is one-hot-based).
 
-    xb: [N, C] row-major bins; rs: [N] clamped per-row split rank;
-    cur: BestSplit fields [K]. Returns go_left [N] bool (garbage on rows
-    whose leaf is not splitting — callers mask with ``active``)."""
+    xb: [N, C] row-major bins (int32 packed words when ``packed_cols``);
+    rs: [N] clamped per-row split rank; cur: BestSplit fields [K].
+    Returns go_left [N] bool (garbage on rows whose leaf is not
+    splitting — callers mask with ``active``)."""
     fk = cur.feature[rs]                                     # [N]
     stored_col = (meta.col[fk] if with_efb else fk).astype(jnp.int32)
-    colv = jnp.take_along_axis(
-        xb, stored_col[:, None], axis=1)[:, 0].astype(jnp.int32)
+    if packed_cols:
+        # gather the routed column's code straight from the packed words
+        # (one per-row word gather + shift/mask — the full unpacked
+        # matrix never materializes on the routing path either)
+        word = jnp.take_along_axis(
+            xb, (stored_col // CODES_PER_WORD)[:, None], axis=1)[:, 0]
+        colv = (word >> ((stored_col % CODES_PER_WORD) * 8)) & 0xFF
+    else:
+        colv = jnp.take_along_axis(
+            xb, stored_col[:, None], axis=1)[:, 0].astype(jnp.int32)
     num_bin_r = meta.num_bin[fk]
     default_bin_r = meta.default_bin[fk]
     if with_efb:
@@ -175,7 +261,8 @@ def wave_plan(best, nl, kw: int, l: int):
 
 
 def wave_route(xb, leaf_id, cur, rank_of_leaf, right_leaf, meta,
-               with_efb: bool, with_categorical: bool):
+               with_efb: bool, with_categorical: bool,
+               packed_cols: int = 0):
     """Route a batch of rows through their leaf's committed split.
     Works on any row slice whose ``leaf_id`` it is given — the full
     dataset in-memory, one resident chunk when streaming."""
@@ -183,7 +270,7 @@ def wave_route(xb, leaf_id, cur, rank_of_leaf, right_leaf, meta,
     active = r_r >= 0
     rs = jnp.maximum(r_r, 0)
     go_left = _route_rows_gather(xb, rs, cur, meta, with_efb,
-                                 with_categorical)
+                                 with_categorical, packed_cols)
     new_leaf_id = jnp.where(active & ~go_left, right_leaf[rs], leaf_id)
     return new_leaf_id, active, rs, go_left
 
@@ -209,10 +296,8 @@ def wave_commit(s: "_FrontierState", kw: int, l: int, gval, gleaf, valid,
     in-memory, a sum of per-chunk sweeps when streaming (histograms are
     additive, so the commit is identical either way)."""
     parent_hist = s.hist_pool[jnp.where(valid, gleaf, 0)]
-    hist_large = parent_hist - hist_small
-    ls = left_small[:, None, None, None]
-    hist_left = jnp.where(ls, hist_small, hist_large)
-    hist_right = jnp.where(ls, hist_large, hist_small)
+    hist_left, hist_right, ch_hist = derive_child_hists(
+        parent_hist, hist_small, left_small, kw)
 
     # pool update: left child reuses the parent's leaf index, right
     # child takes its new leaf; invalid lanes drop
@@ -229,8 +314,6 @@ def wave_commit(s: "_FrontierState", kw: int, l: int, gval, gleaf, valid,
         valid, nvalid, meta, sp, max_depth)
 
     # ---- best splits for all 2K children, one vmapped search --------
-    ch_hist = jnp.stack([hist_left, hist_right],
-                        axis=1).reshape((2 * kw,) + hist_left.shape[1:])
     ch_sg = interleave_lr(cur.left_sum_grad, cur.right_sum_grad)
     ch_sh = interleave_lr(cur.left_sum_hess, cur.right_sum_hess)
     ch_cnt = interleave_lr(cur.left_count, cur.right_count)
@@ -315,23 +398,18 @@ def root_state(hist_root, root_g, root_h, root_c, n: int, l: int, sp,
         health=health0, mstats=mstats0)
 
 
-def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
-                       hess: jnp.ndarray, sample_mask: jnp.ndarray,
-                       meta: FeatureMeta, feature_mask: jnp.ndarray,
-                       params: GrowParams,
-                       axis_name: Optional[str] = None,
-                       ) -> Tuple[TreeArrays, jnp.ndarray,
-                                  Optional[jnp.ndarray]]:
-    """Grow one tree in frontier waves: every positive-gain frontier
-    leaf splits per sequential step, with ONE batched histogram pass per
-    wave. Same contract as grow.grow_tree (minus forced/CEGB); returns
-    (tree, final per-row leaf_id, aux). The aux slot is the [2] f32
-    health accumulator (waves executed, nonfinite committed gain) when
-    ``params.obs_health`` and None otherwise — unless
-    ``params.obs_modelstats``, in which case aux is the 2-tuple
-    ``(health_or_None, mstats)`` with ``mstats`` the f32[F, MS_WIDTH]
-    per-feature (split count, gain sum, gain max) accumulator."""
-    n, ncols = xb.shape
+def _frontier_driver(xb: jnp.ndarray, sample_mask: jnp.ndarray,
+                     meta: FeatureMeta, feature_mask: jnp.ndarray,
+                     params: GrowParams, axis_name: Optional[str]):
+    """Shared machinery of the single-class and class-batched frontier
+    growers: returns ``(seed, wave_step, ladder, kb)`` where
+    ``seed(grad, hess)`` builds the root _FrontierState and
+    ``wave_step(s, grad, hess, kw)`` runs one width-``kw`` wave. Both
+    take gradients explicitly (not by closure) so the class-batched
+    driver can jax.vmap them over the class axis while the ladder
+    selection stays OUTSIDE the vmap."""
+    n = xb.shape[0]
+    ncols = params.word_packed_cols or xb.shape[1]
     l = params.num_leaves
     b = params.num_bins
     sp = params.split
@@ -340,6 +418,8 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
     # config pays full num_leaves-1 slot-sweeps per wave
     kb = frontier_max_width(l, params.max_depth)
     with_efb = params.with_efb
+    packed = params.word_packed_cols
+    sample_mask = sample_mask.astype(jnp.float32)
 
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
@@ -358,26 +438,26 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
     lrn = make_frontier_learner(params, axis_name, meta, feature_mask,
                                 psum, child_best)
 
-    # ---- root (identical to exact mode) ---------------------------------
-    sample_mask = sample_mask.astype(jnp.float32)
-    root_g = psum(jnp.sum(grad * sample_mask))
-    root_h = psum(jnp.sum(hess * sample_mask))
-    root_c = psum(jnp.sum(sample_mask))
-    hist_root = lrn.reduce(build_histogram(xb, grad, hess, sample_mask,
-                                           num_bins=b,
-                                           row_chunk=params.row_chunk,
-                                           impl=params.hist_impl))
-    state = root_state(hist_root, root_g, root_h, root_c, n, l, sp, lrn,
-                       params, feature_mask, axis_name)
+    def seed(grad: jnp.ndarray, hess: jnp.ndarray) -> _FrontierState:
+        # ---- root (identical to exact mode) -----------------------------
+        root_g = psum(jnp.sum(grad * sample_mask))
+        root_h = psum(jnp.sum(hess * sample_mask))
+        root_c = psum(jnp.sum(sample_mask))
+        hist_root = lrn.reduce(build_histogram(
+            xb, grad, hess, sample_mask, num_bins=b,
+            row_chunk=params.row_chunk, impl=params.hist_impl,
+            packed_cols=packed))
+        return root_state(hist_root, root_g, root_h, root_c, n, l, sp,
+                          lrn, params, feature_mask, axis_name)
 
-    def cond_fn(s: _FrontierState) -> jnp.ndarray:
-        return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
-
-    def wave_step(s: _FrontierState, kw: int) -> _FrontierState:
+    def wave_step(s: _FrontierState, grad, hess, kw: int) -> _FrontierState:
         """One frontier wave at static width ``kw`` (1 <= kw <= kb). The
         caller guarantees the live positive-gain frontier fits in ``kw``
         lanes, so the top_k prefix it commits — and therefore the grown
-        structure and numbering — is identical for every width."""
+        structure and numbering — is identical for every width. A wave
+        with NO positive-gain leaf is a perfect no-op (every commit
+        scatter drops), which is what lets the class-batched driver run
+        finished classes through further waves harmlessly."""
         nl = s.tree.num_leaves                    # dynamic scalar
         (gval, gleaf, valid, nvalid, node, right_leaf, cur,
          rank_of_leaf) = wave_plan(s.best, nl, kw, l)
@@ -385,18 +465,23 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         # ---- route every row through its leaf's split -------------------
         leaf_id, active, rs, go_left = wave_route(
             xb, s.leaf_id, cur, rank_of_leaf, right_leaf, meta, with_efb,
-            params.with_categorical)
+            params.with_categorical, packed)
 
         # ---- ONE dataset sweep: smaller child of every split ------------
         # slot = split rank iff the row lands in the SMALLER child of its
         # leaf's split, else -1 (inactive); the larger sibling is derived
         # from the pool by subtraction, so the sweep touches each
-        # splitting row at most once and the wave costs one pass total
+        # splitting row at most once and the wave costs one pass total.
+        # The sweep, subtraction, expand/fix, and the bin-scan best-split
+        # below compile into ONE wave region (wave_fused_entry is the
+        # AOT pricing mirror) — the [kw, C, B, 3] tensor is an internal
+        # value, never a separate dispatch output.
         left_small, slot = wave_slots(cur, active, go_left, rs)
         hist_small = lrn.reduce(build_histogram_frontier(
             xb, slot, grad, hess, sample_mask, num_bins=b, num_slots=kw,
             row_chunk=params.row_chunk,
-            impl=params.hist_impl))                # [kw, C, B, 3]
+            impl=params.hist_impl,
+            packed_cols=packed))                   # [kw, C, B, 3]
 
         (pool, tree, leaf_min, leaf_max, best, health,
          mstats) = wave_commit(
@@ -409,6 +494,33 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
                               mstats=mstats)
 
     ladder = wave_width_ladder(l, params.max_depth)  # pow-2 widths, <= kb
+    return seed, wave_step, ladder, kb
+
+
+def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
+                       hess: jnp.ndarray, sample_mask: jnp.ndarray,
+                       meta: FeatureMeta, feature_mask: jnp.ndarray,
+                       params: GrowParams,
+                       axis_name: Optional[str] = None,
+                       ) -> Tuple[TreeArrays, jnp.ndarray,
+                                  Optional[jnp.ndarray]]:
+    """Grow one tree in frontier waves: every positive-gain frontier
+    leaf splits per sequential step, with ONE batched histogram pass per
+    wave. Same contract as grow.grow_tree (minus forced/CEGB); returns
+    (tree, final per-row leaf_id, aux). The aux slot is the [2] f32
+    health accumulator (waves executed, nonfinite committed gain) when
+    ``params.obs_health`` and None otherwise — unless
+    ``params.obs_modelstats``, in which case aux is the 2-tuple
+    ``(health_or_None, mstats)`` with ``mstats`` the f32[F, MS_WIDTH]
+    per-feature (split count, gain sum, gain max) accumulator."""
+    l = params.num_leaves
+    seed, wave_step, ladder, kb = _frontier_driver(
+        xb, sample_mask, meta, feature_mask, params, axis_name)
+    state = seed(grad, hess)
+
+    def cond_fn(s: _FrontierState) -> jnp.ndarray:
+        return (s.tree.num_leaves < l) & jnp.any(s.best.gain > 0.0)
+
     if params.frontier_bucketing and len(ladder) > 1:
         # adaptive width: count the live frontier and dispatch the wave
         # step specialized at the smallest covering ladder width. ``live``
@@ -419,7 +531,8 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         # bounded by 2^(max_depth-1) and by the nl < l leaf budget), so
         # the chosen width never truncates the live set.
         widths = jnp.asarray(ladder, jnp.int32)
-        branches = [lambda s, w=w: wave_step(s, w) for w in ladder]
+        branches = [lambda s, w=w: wave_step(s, grad, hess, w)
+                    for w in ladder]
 
         def step(s: _FrontierState) -> _FrontierState:
             live = jnp.sum(s.best.gain > 0.0)
@@ -428,9 +541,72 @@ def grow_tree_frontier(xb: jnp.ndarray, grad: jnp.ndarray,
         # fixed width (frontier_bucketing=false, or a degenerate ladder):
         # every wave runs at the clamped maximum
         def step(s: _FrontierState) -> _FrontierState:
-            return wave_step(s, kb)
+            return wave_step(s, grad, hess, kb)
 
     state = lax.while_loop(cond_fn, step, state)
     if params.obs_modelstats:
         return state.tree, state.leaf_id, (state.health, state.mstats)
     return state.tree, state.leaf_id, state.health
+
+
+def grow_tree_frontier_classes(xb: jnp.ndarray, grad: jnp.ndarray,
+                               hess: jnp.ndarray,
+                               sample_mask: jnp.ndarray,
+                               meta: FeatureMeta,
+                               feature_mask: jnp.ndarray,
+                               params: GrowParams,
+                               ) -> Tuple[TreeArrays, jnp.ndarray,
+                                          Optional[jnp.ndarray]]:
+    """Class-batched frontier growth with the wave ladder OUTSIDE the
+    vmap: grad/hess are [K, N] (one row per class) and all K trees grow
+    together, one class-vmapped wave per step.
+
+    The naive ``jax.vmap(grow_tree_frontier)`` forces bucketing off
+    because vmapping a ``lax.switch`` on a batched index lowers to
+    execute-ALL-branches — every wave would pay the whole ladder. Here
+    the while_loop and the switch live at the top level: the branch
+    index is the MAX live frontier over classes (an unbatched scalar, so
+    the switch stays a real single-branch dispatch) and the chosen
+    branch vmaps ``wave_step`` over classes. A class whose frontier is
+    exhausted (or whose leaf budget is spent) runs through later waves
+    as a structural no-op — wave_plan grants it zero valid lanes and
+    every commit write is a drop-mode scatter — so the grown structure
+    of every class is identical to its solo unbucketed run; only the
+    health wave COUNTER sees the shared schedule (it counts global
+    waves, max over classes instead of per-class).
+
+    Serial learner only (the vmapped-multiclass gate never arises on
+    sharded schedules — the GBDT driver keeps mesh multiclass on the
+    pooled path)."""
+    l = params.num_leaves
+    seed, wave_step, ladder, kb = _frontier_driver(
+        xb, sample_mask, meta, feature_mask, params, axis_name=None)
+    states = jax.vmap(seed)(grad, hess)
+
+    def cond_fn(ss: _FrontierState) -> jnp.ndarray:
+        return jnp.any((ss.tree.num_leaves < l)
+                       & jnp.any(ss.best.gain > 0.0, axis=-1))
+
+    if params.frontier_bucketing and len(ladder) > 1:
+        widths = jnp.asarray(ladder, jnp.int32)
+        branches = [
+            lambda ss, w=w: jax.vmap(
+                lambda s, g, h: wave_step(s, g, h, w))(ss, grad, hess)
+            for w in ladder]
+
+        def step(ss: _FrontierState) -> _FrontierState:
+            # widest live frontier over classes still in budget — an
+            # UNBATCHED scalar, so lax.switch dispatches one real branch
+            live_c = jnp.sum(ss.best.gain > 0.0, axis=-1)       # [K]
+            can = ss.tree.num_leaves < l                        # [K]
+            live = jnp.max(jnp.where(can, live_c, 0))
+            return lax.switch(jnp.sum(live > widths), branches, ss)
+    else:
+        def step(ss: _FrontierState) -> _FrontierState:
+            return jax.vmap(
+                lambda s, g, h: wave_step(s, g, h, kb))(ss, grad, hess)
+
+    states = lax.while_loop(cond_fn, step, states)
+    if params.obs_modelstats:
+        return states.tree, states.leaf_id, (states.health, states.mstats)
+    return states.tree, states.leaf_id, states.health

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .binpack import unpack_words
+
 
 def _hist_chunk_matmul(xb_chunk: jnp.ndarray, vals_chunk: jnp.ndarray,
                        num_bins: int) -> jnp.ndarray:
@@ -73,31 +75,42 @@ def hist_tile_vals(xb_rows: jnp.ndarray, vals: jnp.ndarray, num_bins: int,
     return _hist_chunk_matmul(xb_rows, vals, num_bins)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk", "impl"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk", "impl",
+                                             "packed_cols"))
 def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     mask: jnp.ndarray, num_bins: int,
-                    row_chunk: int = 16384, impl: str = "matmul") -> jnp.ndarray:
+                    row_chunk: int = 16384, impl: str = "matmul",
+                    packed_cols: int = 0) -> jnp.ndarray:
     """Build (grad, hess, count) histograms for every feature.
 
     Args:
-      xb: [N, F] binned features (uint8).
+      xb: [N, F] binned features (uint8), or — when ``packed_cols`` > 0 —
+        [N, ceil(F/4)] int32 words holding 4 eight-bit codes each
+        (core/binpack.py; unpack happens inside the chosen impl, never as
+        a second device-resident copy of the matrix).
       grad, hess: [N] f32 gradients/hessians (already weighted by objective).
       mask: [N] f32 row inclusion (leaf membership x bagging); 0 excludes.
       num_bins: static total bin count B (max over features).
       row_chunk: rows per scan step (bounds transient one-hot memory).
       impl: "matmul" (MXU one-hot) or "scatter" (XLA scatter-add).
+      packed_cols: the real column count F when xb is word-packed; 0 =
+        xb is the plain uint8 matrix.
 
     Returns: [F, B, 3] f32.
     """
-    n, f = xb.shape
+    n = xb.shape[0]
+    f = packed_cols or xb.shape[1]
     if impl.startswith("pallas"):
         # pallas | pallas_highest | pallas_interpret | pallas_highest_interpret
         from .histogram_pallas import build_histogram_pallas
         return build_histogram_pallas(xb, grad, hess, mask, num_bins,
                                       interpret=impl.endswith("interpret"),
-                                      highest="highest" in impl)
+                                      highest="highest" in impl,
+                                      packed_cols=packed_cols)
     vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # [N, 3]
     if impl == "scatter" or n <= row_chunk:
+        if packed_cols:
+            xb = unpack_words(xb, packed_cols)
         if impl == "scatter":
             return _hist_scatter(xb, vals, num_bins)
         return _hist_chunk_matmul(xb, vals, num_bins)
@@ -107,11 +120,15 @@ def build_histogram(xb: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     if pad:
         xb = jnp.pad(xb, ((0, pad), (0, 0)))
         vals = jnp.pad(vals, ((0, pad), (0, 0)))  # padded rows have mask 0
-    xb_c = xb.reshape(num_chunks, row_chunk, f)
+    xb_c = xb.reshape(num_chunks, row_chunk, xb.shape[1])
     vals_c = vals.reshape(num_chunks, row_chunk, 3)
 
     def step(acc, chunk):
         xbc, vc = chunk
+        if packed_cols:
+            # per-chunk unpack keeps the transient uint8 tile row_chunk-
+            # sized — the full matrix only ever exists as words
+            xbc = unpack_words(xbc, packed_cols)
         return acc + _hist_chunk_matmul(xbc, vc, num_bins), None
 
     init = jnp.zeros((f, num_bins, 3), dtype=vals.dtype)
@@ -167,12 +184,14 @@ def _frontier_chunk_matmul(xb_chunk: jnp.ndarray, slot_chunk: jnp.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_slots",
-                                             "row_chunk", "impl"))
+                                             "row_chunk", "impl",
+                                             "packed_cols"))
 def build_histogram_frontier(xb: jnp.ndarray, slot: jnp.ndarray,
                              grad: jnp.ndarray, hess: jnp.ndarray,
                              mask: jnp.ndarray, num_bins: int, num_slots: int,
                              row_chunk: int = 16384,
-                             impl: str = "matmul") -> jnp.ndarray:
+                             impl: str = "matmul",
+                             packed_cols: int = 0) -> jnp.ndarray:
     """Histograms for EVERY live frontier leaf in ONE pass over the rows.
 
     The multi-leaf generalization of build_histogram (the level-indexed
@@ -183,7 +202,8 @@ def build_histogram_frontier(xb: jnp.ndarray, slot: jnp.ndarray,
     instead of O(num_leaves).
 
     Args:
-      xb: [N, F] binned features (uint8).
+      xb: [N, F] binned features (uint8), or int32 packed words when
+        ``packed_cols`` > 0 (same contract as build_histogram).
       slot: [N] int32 frontier slot in [0, num_slots), or -1 for rows in no
         frontier leaf (excluded from every slot).
       grad, hess, mask: [N] f32, same contract as build_histogram.
@@ -191,22 +211,28 @@ def build_histogram_frontier(xb: jnp.ndarray, slot: jnp.ndarray,
       impl: "matmul" ((leaf, bin) one-hot MXU contraction) | "scatter"
         (combined-index scatter-add) | pallas spellings (the slot kernel,
         histogram_pallas.build_histogram_frontier_pallas).
+      packed_cols: real column count F when xb is word-packed; 0 = plain.
 
     Returns: [num_slots, F, B, 3] f32 (sum_grad, sum_hess, count).
     """
-    n, f = xb.shape
+    n = xb.shape[0]
+    f = packed_cols or xb.shape[1]
     if impl.startswith("pallas"):
         from .histogram_pallas import build_histogram_frontier_pallas
         vals = jnp.stack([grad * mask, hess * mask, mask], axis=0)  # [3, N]
         return build_histogram_frontier_pallas(
             xb, slot, vals, num_bins=num_bins, n_slots=num_slots,
             interpret=impl.endswith("interpret"),
-            highest="highest" in impl)
+            highest="highest" in impl, packed_cols=packed_cols)
     vals = jnp.stack([grad * mask, hess * mask, mask], axis=-1)     # [N, 3]
     if impl == "scatter":
+        if packed_cols:
+            xb = unpack_words(xb, packed_cols)
         return _frontier_scatter(xb, slot, vals, num_bins, num_slots)
     slot = slot.astype(jnp.int32)
     if n <= row_chunk:
+        if packed_cols:
+            xb = unpack_words(xb, packed_cols)
         return _frontier_chunk_matmul(xb, slot, vals, num_bins, num_slots)
     num_chunks = (n + row_chunk - 1) // row_chunk
     pad = num_chunks * row_chunk - n
@@ -217,12 +243,14 @@ def build_histogram_frontier(xb: jnp.ndarray, slot: jnp.ndarray,
 
     def step(acc, chunk):
         xbc, sc, vc = chunk
+        if packed_cols:
+            xbc = unpack_words(xbc, packed_cols)
         return acc + _frontier_chunk_matmul(xbc, sc, vc, num_bins,
                                             num_slots), None
 
     init = jnp.zeros((num_slots, f, num_bins, 3), dtype=vals.dtype)
     hist, _ = lax.scan(step, init,
-                       (xb.reshape(num_chunks, row_chunk, f),
+                       (xb.reshape(num_chunks, row_chunk, xb.shape[1]),
                         slot.reshape(num_chunks, row_chunk),
                         vals.reshape(num_chunks, row_chunk, 3)))
     return hist

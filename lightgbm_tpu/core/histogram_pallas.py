@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .binpack import unpack_words
+
 
 def _digit_contract(a, eq, highest: bool):
     """Shared MXU contraction of every digit kernel in this file:
@@ -102,42 +104,54 @@ def _hist_kernel(xb_ref, vals_ref, out_ref, *, hi_n: int, highest: bool):
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_tile", "feature_tile",
-                                    "interpret", "highest"))
+                                    "interpret", "highest", "packed_cols"))
 def build_histogram_pallas(xb: jnp.ndarray, grad: jnp.ndarray,
                            hess: jnp.ndarray, mask: jnp.ndarray,
                            num_bins: int, row_tile: int = 2048,
                            feature_tile: int = 8,
                            interpret: bool = False,
-                           highest: bool = False) -> jnp.ndarray:
+                           highest: bool = False,
+                           packed_cols: int = 0) -> jnp.ndarray:
     """[N, F] uint8 bins + per-row values -> [F, B, 3] f32 histograms.
 
-    Same contract as histogram.build_histogram. The feature-major transpose
-    of ``xb`` is loop-invariant across the splits of one tree, so XLA hoists
-    it out of the growth loop.
+    Same contract as histogram.build_histogram (incl. int32-word-packed
+    xb via ``packed_cols``). The feature-major transpose of ``xb`` is
+    loop-invariant across the splits of one tree, so XLA hoists it out of
+    the growth loop.
     """
     vals = jnp.stack([grad * mask, hess * mask, mask], axis=0)   # [3, N]
     return build_histogram_pallas_vals(xb, vals, num_bins, row_tile,
-                                       feature_tile, interpret, highest)
+                                       feature_tile, interpret, highest,
+                                       packed_cols)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_tile", "feature_tile",
-                                    "interpret", "highest"))
+                                    "interpret", "highest", "packed_cols"))
 def build_histogram_pallas_vals(xb: jnp.ndarray, vals: jnp.ndarray,
                                 num_bins: int, row_tile: int = 2048,
                                 feature_tile: int = 8,
                                 interpret: bool = False,
-                                highest: bool = False) -> jnp.ndarray:
+                                highest: bool = False,
+                                packed_cols: int = 0) -> jnp.ndarray:
     """Same kernel with pre-stacked value channels: vals [K, N] -> output
     [F, B, K] (K = 3 for one histogram, 6 for a fused two-child pass)."""
+    if packed_cols:
+        # unpack int32 words straight to int32 lanes (the kernels cast to
+        # int32 anyway and Mosaic has no uint8 casts, so the word layout
+        # is kernel-native: shift/mask, no narrowing)
+        xb = unpack_words(xb, packed_cols, dtype=jnp.int32)
     n, f = xb.shape
     k = vals.shape[0]
     hi_n = max(1, (num_bins + 15) // 16)   # bins above num_bins stay zero
 
     f_pad = (-f) % feature_tile
     n_pad = (-n) % row_tile
-    # NB: uint8, not int8 — bins >= 128 must not wrap negative
-    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad))).astype(jnp.uint8)
+    # NB: uint8, not int8 — bins >= 128 must not wrap negative (packed
+    # lanes stay int32, already masked non-negative)
+    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad)))
+    if not packed_cols:
+        xb_t = xb_t.astype(jnp.uint8)
     vals = jnp.pad(vals, ((0, 0), (0, n_pad)))   # padded rows carry mask 0
     fp = f + f_pad
 
@@ -430,12 +444,14 @@ def _hist_slot_tile(xb_ref, slot, vals, out_ref, *, hi_n, n_slots, highest,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "n_slots", "row_tile",
-                                    "feature_tile", "interpret", "highest"))
+                                    "feature_tile", "interpret", "highest",
+                                    "packed_cols"))
 def build_histogram_slots(xb: jnp.ndarray, slot: jnp.ndarray,
                           vals: jnp.ndarray, num_bins: int, n_slots: int,
                           row_tile: int = 2048, feature_tile: int = 8,
                           interpret: bool = False,
-                          highest: bool = False) -> jnp.ndarray:
+                          highest: bool = False,
+                          packed_cols: int = 0) -> jnp.ndarray:
     """[N, F] uint8 bins + per-row slot ids + [K, N] value channels ->
     [n_slots, F, B, K] f32 histograms — every slot's histogram in ONE pass
     over the rows (the multi-leaf step of batched-frontier growth).
@@ -443,14 +459,19 @@ def build_histogram_slots(xb: jnp.ndarray, slot: jnp.ndarray,
     Rows outside every slot should carry slot -1 (matches no one-hot AND
     lets an all-inactive row tile skip its compute body entirely); zero
     value channels keep them harmless either way. Padding rows are
-    slot -1."""
+    slot -1. ``packed_cols`` > 0: xb is int32 words (core/binpack.py),
+    unpacked here to kernel-native int32 lanes."""
+    if packed_cols:
+        xb = unpack_words(xb, packed_cols, dtype=jnp.int32)
     n, f = xb.shape
     k = vals.shape[0]
     hi_n = max(1, (num_bins + 15) // 16)
 
     f_pad = (-f) % feature_tile
     n_pad = (-n) % row_tile
-    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad))).astype(jnp.uint8)
+    xb_t = jnp.pad(xb.T, ((0, f_pad), (0, n_pad)))
+    if not packed_cols:
+        xb_t = xb_t.astype(jnp.uint8)
     slot2 = jnp.minimum(slot.astype(jnp.int32), n_slots - 1)
     slot2 = jnp.pad(slot2, (0, n_pad),
                     constant_values=-1)[None, :]             # [1, N+pad]
@@ -485,7 +506,8 @@ def build_histogram_frontier_pallas(xb: jnp.ndarray, slot: jnp.ndarray,
                                     n_slots: int, row_tile: int = 2048,
                                     feature_tile: int = 8,
                                     interpret: bool = False,
-                                    highest: bool = False) -> jnp.ndarray:
+                                    highest: bool = False,
+                                    packed_cols: int = 0) -> jnp.ndarray:
     """Frontier-wave entry of the slot kernel: the device path of
     histogram.build_histogram_frontier.
 
@@ -500,4 +522,4 @@ def build_histogram_frontier_pallas(xb: jnp.ndarray, slot: jnp.ndarray,
     return build_histogram_slots(
         xb, slot, vals, num_bins=num_bins, n_slots=n_slots,
         row_tile=row_tile, feature_tile=feature_tile,
-        interpret=interpret, highest=highest)
+        interpret=interpret, highest=highest, packed_cols=packed_cols)

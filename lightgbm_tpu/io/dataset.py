@@ -278,7 +278,20 @@ class BinnedDataset:
             # booster raises if a packed dataset reaches a mesh anyway)
             if config.enable_nbit_packing and \
                     config.tree_learner == "serial" and not config.mesh_shape:
-                self._pack_small_pairs()
+                # tpu_bin_packing=nibble raises the joint-code cap to the
+                # full byte (256) so every <=16-bin pair shares a column
+                # regardless of the dataset's histogram width — the
+                # Dense4bitsBin "two bins per byte" applied dataset-wide
+                # (core/binpack.py). Other modes keep the conservative
+                # cap (B never grows past the widest existing column).
+                from ..core.binpack import resolve_bin_packing
+                from ..core.partition import tpu_shaped_backend
+                mode = resolve_bin_packing(
+                    getattr(config, "tpu_bin_packing", "auto"),
+                    streamed=False, tpu_shaped=tpu_shaped_backend(),
+                    col_num_bin=self.col_num_bin)
+                self._pack_small_pairs(
+                    pair_cap=256 if mode == "nibble" else 0)
 
         # ---- build the stored uint8 columns ------------------------------
         def full_bin_column(j):
@@ -568,16 +581,18 @@ class BinnedDataset:
     def has_packed(self) -> bool:
         return any(self.col_packed)
 
-    def _pack_small_pairs(self) -> None:
+    def _pack_small_pairs(self, pair_cap: int = 0) -> None:
         """Joint-code pairs of small singleton numerical features into one
         stored column (value = bin_a * num_bin_b + bin_b) — the
         Dense4bitsBin idea (dense_nbits_bin.hpp:38-82) re-shaped for the
         [N, C] uint8 matrix: instead of nibble-shifting inside a bin
         object, two features share a column whose joint histogram is
-        marginalized per feature at split-search time. Only applied when
-        the pair fits the dataset's existing histogram width, so B never
-        grows."""
-        b_max = max(self.col_num_bin, default=0)
+        marginalized per feature at split-search time. With ``pair_cap``
+        0 a pair is only formed when it fits the dataset's existing
+        histogram width, so B never grows; tpu_bin_packing=nibble passes
+        256 (the uint8 code space) to force dataset-wide pairing — C
+        halves for small-bin features at the price of a wider B."""
+        b_max = int(pair_cap) or max(self.col_num_bin, default=0)
         cand = [ci for ci in range(len(self.col_features))
                 if len(self.col_features[ci]) == 1
                 and not self.col_packed[ci]

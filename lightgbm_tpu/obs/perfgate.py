@@ -26,7 +26,10 @@ workload:
   regression the wall-clock-free gate can still see).
 
 The committed baseline (PERF_COUNTERS.json) declares every counter with
-its tolerance: ``{"value": v, "tol": t, "mode": "exact"|"rel"}``. A
+its tolerance: ``{"value": v, "tol": t, "mode": "exact"|"rel"|"min"}``
+(``min`` carries a ``floor`` instead of a tolerance — one-sided, for
+ratios that must never regress below a promised multiple, like the
+packed-bin bytes reduction). A
 regression — a grower suddenly sweeping twice per wave, a recompile
 sneaking into the steady state, a bucketing change silently widening
 every wave — fails the gate with a readable diff naming the counter and
@@ -54,10 +57,21 @@ DEFAULT_WORKLOAD: Dict[str, Any] = {
 }
 
 
+# the packed-bin pipeline's headline claim, pinned as a one-sided gate:
+# nibble pair coding + word packing must keep the frontier sweep's
+# cost-model bytes at >= this multiple of the plain-uint8 sweep's
+# (docs/Performance.md "Packed bins & fused wave")
+PACKING_BYTES_FLOOR = 1.5
+
+
 def default_spec(name: str) -> Dict[str, Any]:
     """Tolerance policy for a counter name: XLA cost-model numbers drift
     across compiler releases (fusion decisions change flop/byte
-    accounting), structural counters must not move at all."""
+    accounting), structural counters must not move at all. ``min``
+    counters are one-sided: the measured value may improve freely but
+    must never drop below the declared floor."""
+    if name.startswith("packing_bytes_ratio_"):
+        return {"mode": "min", "tol": 0, "floor": PACKING_BYTES_FLOOR}
     if name.startswith("costmodel_flops_"):
         return {"mode": "rel", "tol": 0.25}
     if name.startswith("costmodel_bytes_"):
@@ -245,7 +259,51 @@ def measure(workload: Optional[Dict[str, Any]] = None
             counters["wave_collectives_" + suffix] = wave[0]
             counters["wave_payload_f32_" + suffix] = wave[1]
     counters.update(_stream_counters(wl))
+    counters.update(_packing_counters())
     return counters, wl
+
+
+def _packing_counters() -> Dict[str, Any]:
+    """The packed-bin traffic win (tpu_bin_packing=nibble), pinned via
+    XLA cost analysis: bytes per frontier-sweep call on a pair-coded
+    word-packed matrix (C/2 joint columns of 256 bins, int32 words)
+    vs the plain uint8 matrix (C columns of 16 bins), at a fixed
+    8192 x 16 probe. Rows are 8192, NOT the 2048-row gate workload:
+    the scatter path's per-row i32 index/update traffic is column-
+    proportional, so the ratio needs enough rows for the column
+    halving to dominate the fixed [W, C, B, 3] output tensor (which
+    GROWS 8x under pair coding and would swamp a small probe).
+    ``mode="min"`` counters: the ratio may improve, never regress
+    below PACKING_BYTES_FLOOR."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.binpack import words_per_row
+    from ..core.histogram import build_histogram_frontier
+    from .costmodel import get_cost_model
+
+    cm = get_cost_model()
+    rows, feats = 8192, 16
+    sds = jax.ShapeDtypeStruct
+    per_row = (sds((rows,), jnp.int32),        # slot
+               sds((rows,), jnp.float32),      # grad
+               sds((rows,), jnp.float32),      # hess
+               sds((rows,), jnp.float32))      # mask
+    counters: Dict[str, Any] = {}
+    for w in (1, 8):
+        plain = cm.analyze(
+            "packprobe_plain_w%d" % w, build_histogram_frontier,
+            sds((rows, feats), jnp.uint8), *per_row,
+            num_bins=16, num_slots=w, row_chunk=4096, impl="scatter")
+        packed = cm.analyze(
+            "packprobe_packed_w%d" % w, build_histogram_frontier,
+            sds((rows, words_per_row(feats // 2)), jnp.int32), *per_row,
+            num_bins=256, num_slots=w, row_chunk=4096, impl="scatter",
+            packed_cols=feats // 2)
+        counters["packing_bytes_ratio_w%d" % w] = round(
+            plain["bytes_accessed"] / max(packed["bytes_accessed"], 1.0),
+            4)
+    return counters
 
 
 def _stream_counters(wl: Dict[str, Any]) -> Dict[str, Any]:
@@ -302,6 +360,13 @@ def _stream_counters(wl: Dict[str, Any]) -> Dict[str, Any]:
         float(backend_compile_count() - c0)
     counters["stream_sweeps_per_tree"] = round(
         b4._stream.sweeps / max(b4._stream_grower.trees_grown, 1), 6)
+    # fused last-chunk+commit dispatch: per wave the grower issues
+    # wave_begin + one kernel per chunk (the final one carrying the
+    # commit), so dispatches/wave - chunks == 1 exactly, invariant in
+    # chunk count — a regression to a standalone commit reads 2 here
+    g4 = b4._stream_grower
+    counters["stream_dispatch_overhead_per_wave"] = round(
+        g4.wave_dispatches / max(g4.waves, 1) - b4._stream.num_chunks, 6)
     return counters
 
 
@@ -390,6 +455,16 @@ def compare(baseline: Dict[str, Any], measured: Dict[str, Any]
             violations.append({"counter": name, "baseline": want,
                                "measured": None,
                                "reason": "counter not measured"})
+        elif mode == "min":
+            floor = float(spec.get("floor", want))
+            ok = float(have) >= floor
+            status = "ok (>= %s floor)" % _fmt(floor) if ok else \
+                "FAIL (< %s floor)" % _fmt(floor)
+            if not ok:
+                violations.append({
+                    "counter": name, "baseline": floor, "measured": have,
+                    "reason": "value %.4f below floor %.4f"
+                    % (float(have), floor)})
         elif mode == "rel":
             denom = max(abs(float(want)), 1e-12)
             drift = abs(float(have) - float(want)) / denom

@@ -177,12 +177,14 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
             h = jnp.ones((n,), jnp.float32)
         mask = jnp.ones((n,), jnp.float32)
 
+        packed = int(getattr(params, "word_packed_cols", 0) or 0)
         out["hist_full"] = _timed(
             build_histogram, xb, g, h, mask, num_bins=params.num_bins,
-            row_chunk=params.row_chunk, impl=params.hist_impl)
+            row_chunk=params.row_chunk, impl=params.hist_impl,
+            packed_cols=packed)
         hist = build_histogram(xb, g, h, mask, num_bins=params.num_bins,
                                row_chunk=params.row_chunk,
-                               impl=params.hist_impl)
+                               impl=params.hist_impl, packed_cols=packed)
 
         part = init_partition(n, params.num_leaves, params.row_chunk)
         # sized to the partition TILE, not n: the decision closure below
@@ -192,10 +194,20 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
             np.arange(max(n, params.row_chunk), dtype=np.int64) % 2 == 0)
         # probe in f32 regardless of ambient x64: the gather closure owns
         # the packed bins/values boundary, so dtypes must be consistent
+        # the partition machinery gathers plain uint8 columns — probe it
+        # on a transient unpacked view when the device matrix is
+        # word-packed (the frontier grower routes from words directly;
+        # these two probes price the EXACT grower's phases)
+        if packed:
+            from .core.binpack import unpack_words
+            xb_cols = unpack_words(xb, packed)
+        else:
+            xb_cols = xb
         gr = make_row_gather(
-            xb, stack_vals(g.astype(jnp.float32), h.astype(jnp.float32),
-                           mask.astype(jnp.float32)))
-        ncols = xb.shape[1]
+            xb_cols, stack_vals(g.astype(jnp.float32),
+                                h.astype(jnp.float32),
+                                mask.astype(jnp.float32)))
+        ncols = xb_cols.shape[1]
         # the real growth path: one fused pass that partitions the root and
         # prices both children — same placement selection as grow_tree
         # (sort path on device / pallas_interpret, scatter loop on CPU)
@@ -235,7 +247,8 @@ def phase_probe(booster, trace_dir: Optional[str] = None) -> Dict[str, float]:
                 t_w = _timed(
                     build_histogram_frontier, xb, slots_w, g, h, mask,
                     num_bins=params.num_bins, num_slots=w,
-                    row_chunk=params.row_chunk, impl=params.hist_impl)
+                    row_chunk=params.row_chunk, impl=params.hist_impl,
+                    packed_cols=packed)
                 out["frontier_hist_w%d" % w] = t_w
                 if w == ladder[-1]:      # full width: the pre-bucketing key
                     out["frontier_hist"] = t_w

@@ -14,17 +14,24 @@ mask, leaf ids) stays device-resident at full length, padded to
 they contribute exactly zero to every histogram channel and every
 gradient sum, and their (meaningless) leaf ids are never read.
 
-The wave is cut into three fixed-shape jitted kernels built from the
-SAME helpers the in-memory grower uses (wave_plan / wave_route /
-wave_slots / wave_commit / root_state):
+The wave is cut into fixed-shape jitted kernels built from the SAME
+helpers the in-memory grower uses (wave_plan / wave_route / wave_slots /
+wave_commit / root_state):
 
 - ``_wave_begin``  — per-leaf planning + the loop condition (the ONE
   host sync per wave: a single bool decides whether to sweep);
-- ``_chunk_wave``  — per chunk: dynamic-slice the chunk's rows out of
-  the full per-row arrays, route them, accumulate the smaller-child
-  histogram partial (fixed [R, C] chunk shape -> compiles once,
-  independent of how many chunks the dataset has);
-- ``_wave_commit`` — sibling subtraction, pool/tree/best updates.
+- ``_chunk_wave``  — per non-final chunk: dynamic-slice the chunk's
+  rows out of the full per-row arrays, route them, accumulate the
+  smaller-child histogram partial (fixed [R, C] chunk shape ->
+  compiles once, independent of how many chunks the dataset has);
+- ``_chunk_wave_commit`` — the FINAL chunk's sweep fused with the
+  sibling subtraction and pool/tree/best commit: chunks+1 dispatches
+  per wave, and the [W, C, B, 3] wave histogram never materializes as
+  a standalone dispatch output.
+
+When the dataset is word-packed (``tpu_bin_packing``, core/binpack.py)
+the chunks arrive as int32 words and both sweep kernels unpack lanes
+in-register; routing gathers the split column straight from the words.
 
 Wave width is FIXED at ``frontier_max_width`` (the bucketing ladder is
 disabled when streaming): a ladder would multiply the per-chunk kernel
@@ -67,6 +74,8 @@ class StreamFrontierGrower:
         self.pipeline = pipeline
         self.params = params
         self.trees_grown = 0
+        self.waves = 0
+        self.wave_dispatches = 0   # jitted calls inside wave loops
         p = params
         R = pipeline.chunk_rows
         ncols = pipeline.num_cols
@@ -102,7 +111,8 @@ class StreamFrontierGrower:
             m_c = lax.dynamic_slice(mask, (start,), (R,))
             return acc + build_histogram(
                 xb_c, g_c, h_c, m_c, num_bins=b,
-                row_chunk=p.row_chunk, impl=p.hist_impl)
+                row_chunk=p.row_chunk, impl=p.hist_impl,
+                packed_cols=p.word_packed_cols)
 
         def root_commit(hist_acc, root_g, root_h, root_c, fmask):
             lrn = make_lrn(fmask)
@@ -126,16 +136,18 @@ class StreamFrontierGrower:
             m_c = lax.dynamic_slice(mask, (start,), (R,))
             new_lid, active, rs, go_left = wave_route(
                 xb_c, lid_c, cur, rank_of_leaf, right_leaf, meta_,
-                p.with_efb, p.with_categorical)
+                p.with_efb, p.with_categorical,
+                packed_cols=p.word_packed_cols)
             _left_small, slot = wave_slots(cur, active, go_left, rs)
             part = build_histogram_frontier(
                 xb_c, slot, g_c, h_c, m_c, num_bins=b, num_slots=kb,
-                row_chunk=p.row_chunk, impl=p.hist_impl)
+                row_chunk=p.row_chunk, impl=p.hist_impl,
+                packed_cols=p.word_packed_cols)
             leaf_id = lax.dynamic_update_slice(leaf_id, new_lid, (start,))
             return leaf_id, hist_acc + part
 
-        def wave_commit_fn(s: _FrontierState, plan, hist_small, leaf_id,
-                           fmask):
+        def commit_state(s: _FrontierState, plan, hist_small, leaf_id,
+                         fmask):
             lrn = make_lrn(fmask)
             (gval, gleaf, valid, nvalid, node, right_leaf, cur,
              rank_of_leaf) = plan
@@ -150,12 +162,24 @@ class StreamFrontierGrower:
                                   leaf_max=leaf_max, health=health,
                                   mstats=mstats)
 
+        def chunk_wave_commit(xb_c, start, s: _FrontierState, leaf_id,
+                              grad, hess, mask, plan, hist_acc, fmask):
+            # LAST chunk of the wave: its sweep, the sibling subtraction
+            # and the 2K-child bin-scan commit fuse into ONE dispatch, so
+            # the [W, C, B, 3] wave histogram never leaves the compiled
+            # region as a standalone output (chunks+2 -> chunks+1
+            # dispatches per wave — the streamed analog of the in-memory
+            # grower's fused wave body)
+            leaf_id, hist_acc = chunk_wave(xb_c, start, leaf_id, grad,
+                                           hess, mask, plan, hist_acc)
+            return commit_state(s, plan, hist_acc, leaf_id, fmask)
+
         self._root_sums = jax.jit(root_sums)
         self._root_chunk = jax.jit(root_chunk)
         self._root_commit = jax.jit(root_commit)
         self._wave_begin = jax.jit(wave_begin)
         self._chunk_wave = jax.jit(chunk_wave)
-        self._wave_commit = jax.jit(wave_commit_fn)
+        self._chunk_wave_commit = jax.jit(chunk_wave_commit)
 
     # ----------------------------------------------------------------- grow
     def grow(self, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -175,6 +199,7 @@ class StreamFrontierGrower:
         state = self._root_commit(acc, root_g, root_h, root_c,
                                   feature_mask)
 
+        last = pipe.num_chunks - 1
         while True:
             do, plan = self._wave_begin(state)
             if not bool(do):          # the one host sync per wave
@@ -182,12 +207,22 @@ class StreamFrontierGrower:
             hist_acc = jnp.zeros((self.wave_width,) + self._hist_shape,
                                  jnp.float32)
             leaf_id = state.leaf_id
+            dispatches = 1            # wave_begin
             for i, xb_c in pipe.sweep():
-                leaf_id, hist_acc = self._chunk_wave(
-                    xb_c, jnp.int32(i * R), leaf_id, grad, hess,
-                    sample_mask, plan, hist_acc)
-            state = self._wave_commit(state, plan, hist_acc, leaf_id,
-                                      feature_mask)
+                if i == last:
+                    # final chunk: sweep + sibling subtraction + commit
+                    # in one fused dispatch (the wave histogram stays an
+                    # internal value of the compiled region)
+                    state = self._chunk_wave_commit(
+                        xb_c, jnp.int32(i * R), state, leaf_id, grad,
+                        hess, sample_mask, plan, hist_acc, feature_mask)
+                else:
+                    leaf_id, hist_acc = self._chunk_wave(
+                        xb_c, jnp.int32(i * R), leaf_id, grad, hess,
+                        sample_mask, plan, hist_acc)
+                dispatches += 1
+            self.waves += 1
+            self.wave_dispatches += dispatches
 
         self.trees_grown += 1
         if self.params.obs_modelstats:

@@ -65,10 +65,20 @@ def repack_uniform(chunks: List[np.ndarray], chunk_rows: int
 
 
 class ChunkPipeline:
-    """Prefetching iterator over uniform device-resident bin chunks."""
+    """Prefetching iterator over uniform device-resident bin chunks.
+
+    ``packed=True`` stores the uniform host chunks word-packed (int32,
+    4 codes per word — core/binpack.py) so every transfer lands in the
+    kernel-native layout the packed histogram impls consume directly.
+    The byte volume per row is unchanged by the words themselves
+    (ceil(C/4)*4 vs C); the transfer halving of ``tpu_bin_packing=
+    nibble`` comes from the DATASET pair coding having halved C before
+    the chunks were quantized. ``num_cols`` always reports the real
+    stored-column count C, not the word count.
+    """
 
     def __init__(self, chunks: List[np.ndarray], chunk_rows: int,
-                 prefetch: int = 2, device=None):
+                 prefetch: int = 2, device=None, packed: bool = False):
         self.chunk_rows = int(chunk_rows)
         self.prefetch = max(1, int(prefetch))
         self.device = device
@@ -77,6 +87,10 @@ class ChunkPipeline:
         self.num_chunks = len(self.host_chunks)
         self.num_cols = self.host_chunks[0].shape[1] if self.host_chunks \
             else 0
+        self.packed = bool(packed)
+        if self.packed:
+            from ..core.binpack import pack_words_np
+            self.host_chunks = [pack_words_np(c) for c in self.host_chunks]
         self.num_padded = self.num_chunks * self.chunk_rows
         # valid (unpadded) rows of each uniform chunk
         self.valid_rows = [

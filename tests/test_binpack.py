@@ -1,0 +1,271 @@
+"""Packed-bin histogram pipeline (core/binpack.py, tpu_bin_packing).
+
+The contract under test, per docs/Performance.md "Packed bins & fused
+wave":
+
+- word pack -> unpack round-trips bit-exactly for any column count;
+- every histogram impl (matmul, scatter, pallas interpret) produces
+  BITWISE identical histograms from the packed words and the plain
+  uint8 matrix;
+- ``tpu_bin_packing=byte`` training is bitwise identical to unpacked
+  training (dense, EFB-bundled, categorical — the words are pure
+  storage);
+- ``tpu_bin_packing=nibble`` training is structure-identical (pair
+  coding reorders f32 accumulation within a joint column);
+- streamed packed chunks are bitwise identical to unpacked streaming,
+  and each wave runs in chunks+1 dispatches (fused last-chunk+commit);
+- vmapped multiclass growth keeps the bucketing ladder (the width
+  switch hoisted outside the vmap) with bitwise-identical trees;
+- the fused-wave cost entries scale with wave width, and the nibble
+  bytes reduction holds the >= 1.5x floor the perf gate pins.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.core.binpack import (gather_code_columns, pack_words_np,
+                                       resolve_bin_packing, unpack_words,
+                                       unpack_words_np, words_per_row)
+
+
+def _model_body(bst):
+    """Model dump minus the echoed-params line (which records the
+    tpu_bin_packing / data_stream settings under test)."""
+    return [l for l in bst.model_to_string().splitlines()
+            if "tpu_bin_packing" not in l and "data_stream" not in l]
+
+
+def _structure(lines):
+    keep = ("split_feature", "num_leaves", "left_child", "right_child",
+            "decision_type")
+    return [l for l in lines if any(l.startswith(k) for k in keep)]
+
+
+def _mixed_xy(n=1600, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([
+        rng.randn(n, 3),                                     # wide bins
+        rng.randint(0, 8, size=(n, 4)).astype(np.float64),   # <=16 bins
+        rng.randint(0, 6, size=(n, 1)).astype(np.float64),   # categorical
+    ], axis=1).astype(np.float32)
+    y = ((X[:, 0] + (X[:, 3] > 4) + 0.5 * (X[:, 7] == 2)
+          + 0.3 * X[:, 1]) > 1).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, extra, rounds=3, categorical=None):
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+              "max_depth": 4, "tree_growth": "frontier", "seed": 0}
+    params.update(extra)
+    ds = lgb.Dataset(X, label=y, categorical_feature=categorical or [])
+    return lgb.train(params, ds, num_boost_round=rounds)
+
+
+# ------------------------------------------------------------ layout
+def test_word_roundtrip_all_tail_shapes():
+    rng = np.random.RandomState(0)
+    for c in (1, 3, 4, 5, 8, 9, 17):
+        xb = rng.randint(0, 256, size=(37, c)).astype(np.uint8)
+        xw = pack_words_np(xb)
+        assert xw.shape == (37, words_per_row(c)) and xw.dtype == np.int32
+        np.testing.assert_array_equal(unpack_words_np(xw, c), xb)
+        np.testing.assert_array_equal(np.asarray(unpack_words(xw, c)), xb)
+        # routing's per-row column gather straight from the words
+        import jax.numpy as jnp
+        cols = jnp.asarray(rng.randint(0, c, size=37), jnp.int32)
+        got = np.asarray(gather_code_columns(jnp.asarray(xw), cols))
+        want = xb[np.arange(37), np.asarray(cols)]
+        np.testing.assert_array_equal(got, want.astype(got.dtype))
+
+
+def test_resolve_bin_packing_policy():
+    small = [14, 16, 9]
+    wide = [14, 200, 9]
+    # explicit modes pass through untouched
+    for m in ("none", "nibble", "byte"):
+        assert resolve_bin_packing(m, streamed=True, tpu_shaped=True,
+                                   col_num_bin=small) == m
+    # auto: nibble on TPU-shaped when every column fits 16 bins
+    assert resolve_bin_packing("auto", streamed=False, tpu_shaped=True,
+                               col_num_bin=small) == "nibble"
+    assert resolve_bin_packing("auto", streamed=False, tpu_shaped=True,
+                               col_num_bin=wide) == "byte"
+    # auto: streamed ingest keeps the kernel-native words even on CPU
+    assert resolve_bin_packing("auto", streamed=True, tpu_shaped=False,
+                               col_num_bin=small) == "byte"
+    # auto: plain in-memory CPU stays unpacked
+    assert resolve_bin_packing("auto", streamed=False, tpu_shaped=False,
+                               col_num_bin=small) == "none"
+
+
+def test_invalid_mode_rejected():
+    X, y = _mixed_xy(n=200)
+    with pytest.raises(lgb.LightGBMError):
+        _train(X, y, {"tpu_bin_packing": "nibbles"}, rounds=1)
+
+
+# ------------------------------------------------------------ kernels
+def test_packed_histograms_bitwise_across_impls():
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.histogram import (build_histogram,
+                                             build_histogram_frontier)
+
+    rng = np.random.RandomState(1)
+    n, c, b = 2048, 7, 16
+    xb = rng.randint(0, b, size=(n, c)).astype(np.uint8)
+    xw = jnp.asarray(pack_words_np(xb))
+    xb = jnp.asarray(xb)
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(rng.rand(n).astype(np.float32))
+    m = jnp.asarray((rng.rand(n) > 0.1).astype(np.float32))
+    slot = jnp.asarray(rng.randint(-1, 4, size=n).astype(np.int32))
+    for impl in ("scatter", "matmul", "pallas_interpret"):
+        plain = build_histogram(xb, g, h, m, num_bins=b, row_chunk=512,
+                                impl=impl)
+        packed = build_histogram(xw, g, h, m, num_bins=b, row_chunk=512,
+                                 impl=impl, packed_cols=c)
+        np.testing.assert_array_equal(np.asarray(plain),
+                                      np.asarray(packed), err_msg=impl)
+        plain_f = build_histogram_frontier(
+            xb, slot, g, h, m, num_bins=b, num_slots=4, row_chunk=512,
+            impl=impl)
+        packed_f = build_histogram_frontier(
+            xw, slot, g, h, m, num_bins=b, num_slots=4, row_chunk=512,
+            impl=impl, packed_cols=c)
+        np.testing.assert_array_equal(np.asarray(plain_f),
+                                      np.asarray(packed_f), err_msg=impl)
+
+
+# ------------------------------------------------------------ training
+@pytest.mark.slow
+def test_byte_mode_bitwise_identity():
+    """byte mode changes only the storage layout: same dataset, same
+    accumulation order, bitwise-identical model dump — across dense,
+    EFB-bundled and categorical features."""
+    X, y = _mixed_xy()
+    plain = _train(X, y, {"tpu_bin_packing": "none"}, categorical=[7])
+    packed = _train(X, y, {"tpu_bin_packing": "byte"}, categorical=[7])
+    assert packed._impl.grow_params.word_packed_cols > 0
+    assert _model_body(plain) == _model_body(packed)
+
+
+@pytest.mark.slow
+def test_nibble_mode_structure_identity():
+    """nibble mode raises the joint-coding cap to 256 ("two bins per
+    byte" dataset-wide): at max_bin<=16 the default cap (= dataset max
+    bins) blocks almost all pairing, nibble halves the stored columns.
+    Trees keep identical structure; values drift only by f32
+    accumulation order within joint columns — so the fixture spreads
+    well-separated gain weights across the features (a near-gain-tie
+    would let that drift flip the winner, the same caveat streaming
+    documents)."""
+    rng = np.random.RandomState(0)
+    n = 1600
+    X = np.concatenate([
+        rng.randn(n, 3),
+        rng.randint(0, 8, size=(n, 4)).astype(np.float64),
+        rng.randint(0, 6, size=(n, 1)).astype(np.float64),
+    ], axis=1).astype(np.float32)
+    y = ((1.7 * X[:, 0] + 0.9 * (X[:, 3] > 4) + 0.45 * (X[:, 7] == 2)
+          + 0.23 * X[:, 1] + 0.11 * X[:, 4]) > 1).astype(np.float32)
+    plain = _train(X, y, {"tpu_bin_packing": "none", "max_bin": 16,
+                          "num_leaves": 7})
+    nib = _train(X, y, {"tpu_bin_packing": "nibble", "max_bin": 16,
+                        "num_leaves": 7})
+    ds_p = plain._impl.train_data
+    ds_n = nib._impl.train_data
+    assert ds_n.has_packed and ds_n.num_columns < ds_p.num_columns
+    assert ds_n.num_columns <= (ds_p.num_columns + 1) // 2
+    assert _structure(_model_body(plain)) == _structure(_model_body(nib))
+    np.testing.assert_allclose(plain.predict(X), nib.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_streamed_packed_chunk_parity():
+    """Streamed word-packed chunks (the auto default for streaming) are
+    bitwise identical to unpacked streaming, and each wave dispatches
+    chunks+1 kernels (the final chunk's sweep fused with the commit)."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(4000, 9).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    st_packed = _train(X, y, {"data_stream_chunk_rows": 1000})
+    st_plain = _train(X, y, {"data_stream_chunk_rows": 1000,
+                             "tpu_bin_packing": "none"})
+    mem = _train(X, y, {"tpu_bin_packing": "none"})
+    assert st_packed._impl._stream.packed
+    assert not st_plain._impl._stream.packed
+    assert _model_body(st_packed) == _model_body(st_plain)
+    assert _structure(_model_body(st_packed)) == \
+        _structure(_model_body(mem))
+    g = st_packed._impl._stream_grower
+    chunks = st_packed._impl._stream.num_chunks
+    assert g.waves > 0
+    assert g.wave_dispatches / g.waves == chunks + 1
+
+
+@pytest.mark.slow
+def test_vmapped_multiclass_keeps_bucketing_identity():
+    """The class-batched frontier grower hoists the wave-width switch
+    outside the vmap: bucketing stays ON under vmapped multiclass and
+    the grown trees are bitwise identical to the fixed-width run (every
+    class's structure matches its solo growth by the no-op-wave
+    argument in grow_tree_frontier_classes)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(1500, 8).astype(np.float32)
+    y = rng.randint(0, 3, 1500).astype(np.float32)
+
+    def train(extra):
+        p = {"objective": "multiclass", "num_class": 3, "verbosity": -1,
+             "num_leaves": 15, "max_depth": 4,
+             "tree_growth": "frontier", "seed": 0}
+        p.update(extra)
+        return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=3)
+
+    bucketed = train({"tpu_frontier_bucketing": True})
+    fixed = train({"tpu_frontier_bucketing": False})
+    p = bucketed._impl.grow_params
+    assert p.vmapped_classes and p.frontier_bucketing
+    assert [l for l in bucketed.model_to_string().splitlines()
+            if "tpu_" not in l] == \
+        [l for l in fixed.model_to_string().splitlines()
+         if "tpu_" not in l]
+
+
+# ------------------------------------------------------------ costs
+@pytest.mark.slow
+def test_fused_wave_costs_scale_with_width():
+    """The frontier_wave_w* entries price the WHOLE fused wave region
+    (sweep + subtraction + 2K-child bin scan), so per-bucket flops must
+    strictly grow with the wave width — unlike the bare scatter sweep,
+    whose flops are width-invariant (its update traffic is [n, C, 3]
+    regardless of slot count), which is why the sweep-only entries
+    could never distinguish buckets."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(512, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = _train(X, y, {}, rounds=1)
+    out = bst._impl.extract_cost_model(force=True)
+    widths = [1, 2, 4, 8]
+    prev = 0.0
+    for w in widths:
+        name = "frontier_wave_w%d" % w
+        assert name in out
+        assert out[name]["flops"] > prev, name
+        prev = out[name]["flops"]
+    # and the fused entries dominate their sweep-only counterparts
+    for w in widths:
+        assert out["frontier_wave_w%d" % w]["flops"] > \
+            out["frontier_hist_w%d" % w]["flops"]
+
+
+@pytest.mark.slow
+def test_packing_bytes_ratio_floor():
+    """The headline reduction the perf gate pins: nibble pair coding +
+    word packing cut the frontier sweep's cost-model bytes by >= 1.5x
+    at the 8192-row probe (both the w=1 and w=8 buckets)."""
+    from lightgbm_tpu.obs.perfgate import (PACKING_BYTES_FLOOR,
+                                           _packing_counters)
+    counters = _packing_counters()
+    assert counters["packing_bytes_ratio_w1"] >= PACKING_BYTES_FLOOR
+    assert counters["packing_bytes_ratio_w8"] >= PACKING_BYTES_FLOOR

@@ -266,8 +266,10 @@ def test_committed_baseline_is_wellformed():
     assert base["workload"]["rows"] > 0
     assert len(base["counters"]) >= 10
     for name, spec in base["counters"].items():
-        assert spec["mode"] in ("exact", "rel"), name
+        assert spec["mode"] in ("exact", "rel", "min"), name
         assert "value" in spec and "tol" in spec, name
+        if spec["mode"] == "min":
+            assert spec["floor"] > 0, name
     # the structural invariants the gate exists to protect
     assert base["counters"]["compiles_after_warmup"]["value"] == 0
     assert base["counters"]["health_vec_width"]["value"] == 4

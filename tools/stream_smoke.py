@@ -12,7 +12,10 @@ pipeline, cross-chunk frontier growth — then verifies from the outside:
 - the dataset really was chunked (>= 4 chunks) and the bin matrix was
   never materialized whole (``X_binned is None``);
 - the pipeline's overlap accounting is sane and reported: sweeps,
-  rows transferred, overlap_efficiency in [0, 1], ingest rows/sec.
+  rows transferred, overlap_efficiency in [0, 1], ingest rows/sec;
+- host chunks are word-packed exactly when ``--bin-packing`` says so
+  (auto resolves to byte for streaming), and every wave runs in
+  chunks+1 dispatches (the last chunk's sweep fused with the commit).
 
 Exit code 0 = every assertion holds. The summary JSON goes to ``--out``
 (and stdout) so CI uploads it as an artifact; the numbers feed the
@@ -47,6 +50,10 @@ def main() -> int:
     ap.add_argument("--chunk-rows", type=int, default=2000,
                     help="rows per chunk (dataset is rows/chunk-rows chunks)")
     ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--bin-packing", default="auto",
+                    choices=("auto", "none", "nibble", "byte"),
+                    help="tpu_bin_packing for the STREAMED run (auto "
+                    "resolves to byte: word-packed host chunks)")
     args = ap.parse_args()
     os.makedirs(args.workdir, exist_ok=True)
 
@@ -81,7 +88,7 @@ def main() -> int:
 
     # ---- streamed run from the .npy mmap source ------------------------
     sp = dict(params, data_stream_chunk_rows=args.chunk_rows,
-              data_stream_prefetch=2)
+              data_stream_prefetch=2, tpu_bin_packing=args.bin_packing)
     ds = lgb.Dataset(npy, label=y, params=sp)
     bst = lgb.train(dict(sp), ds, num_boost_round=args.iters)
 
@@ -110,7 +117,19 @@ def main() -> int:
     pipe = bst._impl._stream
     check(pipe is not None, "trainer holds a ChunkPipeline")
     stats = pipe.stats() if pipe is not None else {}
+    packed = bool(pipe is not None and pipe.packed)
     if pipe is not None:
+        want_packed = args.bin_packing != "none"
+        check(packed == want_packed,
+              "host chunks %s word-packed (tpu_bin_packing=%s)"
+              % ("are" if want_packed else "are NOT", args.bin_packing))
+        grower = bst._impl._stream_grower
+        if grower is not None and grower.waves:
+            per_wave = grower.wave_dispatches / grower.waves
+            check(per_wave == pipe.num_chunks + 1,
+                  "chunks+1 dispatches per wave — last chunk's sweep "
+                  "fused with the commit (%.2f vs %d chunks)"
+                  % (per_wave, pipe.num_chunks))
         check(stats["num_chunks"] == nchunks,
               "pipeline sweeps all %d chunks" % nchunks)
         check(stats["sweeps"] >= args.iters,
@@ -128,7 +147,8 @@ def main() -> int:
     summary = {"rows": n, "chunk_rows": args.chunk_rows,
                "num_chunks": nchunks, "iterations": args.iters,
                "structure_identical": s_base == s_stream,
-               "max_pred_delta": max_dp,
+               "max_pred_delta": max_dp, "bin_packing": args.bin_packing,
+               "chunks_word_packed": packed,
                "pipeline": stats, "failures": failures}
     blob = json.dumps(summary, indent=2, sort_keys=True)
     print(blob)
