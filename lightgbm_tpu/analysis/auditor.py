@@ -173,6 +173,16 @@ def collect_audit(workload: Optional[Dict[str, Any]] = None
         jaxpr_audit.audit_jaxpr(jax.make_jaxpr(centry._fn)(
             ctrees_sds, sds((wl["min_bucket"], nf), jnp.float32)))
 
+    # ---- fleet refit core (fleet/refit.py): the scan-over-iterations
+    # leaf re-estimation program, traced at the audit workload's row
+    # count. Pins the continuous-training loop's structural fingerprint
+    # the same way the predict entries pin serving: zero collectives,
+    # zero host callbacks, stable equation count.
+    from ..fleet.refit import refit_audit_entry
+    rfn, rargs = refit_audit_entry(bst, rows=wl["rows"])
+    entries["fleet_refit"] = jaxpr_audit.audit_jaxpr(
+        jax.make_jaxpr(rfn)(*rargs))
+
     # ---- donation effectiveness (the one AOT compile of the audit)
     donation: Dict[str, Any] = {}
     if block > 0 and getattr(b, "_iter_capture", None) is not None:

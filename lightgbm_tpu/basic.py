@@ -791,7 +791,12 @@ class Booster:
         """Refit existing tree structures to new data (RefitTree,
         gbdt.cpp:263-286 + FitByExistingTree, serial_tree_learner.cpp:235-265):
         every split is kept, leaf outputs are re-estimated from the new data's
-        gradients and blended with the old outputs by ``decay_rate``."""
+        gradients and blended with the old outputs by ``decay_rate``.
+
+        Dense inputs take the device path (fleet/refit.py: one flat-forest
+        traversal + one scan over iterations, compiled once and reused;
+        ``refit_device=false`` forces this host loop). Sparse inputs stay
+        on the host's streamed-block path — it never densifies."""
         import jax
         import jax.numpy as jnp
         from .core import tree as tree_mod
@@ -802,6 +807,10 @@ class Booster:
         check(self._objective is not None,
               "Cannot refit a model trained with a custom objective")
         sparse_in = hasattr(data, "toarray") and not hasattr(data, "dtypes")
+        if not sparse_in and self.config.refit_device:
+            from .fleet.refit import refit_booster
+            return refit_booster(self, data, label, decay_rate=decay_rate,
+                                 weight=weight, group=group)
         if sparse_in:
             data = data.tocsr()
             n = int(data.shape[0])

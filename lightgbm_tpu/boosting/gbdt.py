@@ -670,6 +670,25 @@ class GBDT:
         self._rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._bag_mask = jnp.ones((n,), jnp.float32)
+        # group-aware bagging: under a ranking objective, bagging samples
+        # whole QUERY GROUPS — one uniform per query broadcast to its rows
+        # — never fractions of a query (a partial query corrupts every
+        # pairwise lambda and NDCG normalizer within it). row_group maps
+        # row -> query index; mesh-padding rows get a synthetic trailing
+        # group (they are masked out by _row_valid regardless).
+        self._row_group = None
+        qb_meta = ds.metadata.query_boundaries
+        if qb_meta is not None and \
+                getattr(self.objective, "name", "") == "lambdarank":
+            qb_arr = np.asarray(qb_meta, np.int64)
+            groups = np.repeat(np.arange(len(qb_arr) - 1, dtype=np.int32),
+                               np.diff(qb_arr))
+            if len(groups) < n:
+                groups = np.concatenate([
+                    groups, np.full(n - len(groups), len(qb_arr) - 1,
+                                    np.int32)])
+            self._row_group = jnp.asarray(groups[:n])
+            self._num_groups = int(len(qb_arr))  # num_queries + pad group
         self._compiled_iter = None
         self._iter_core = None
         self._compiled_block = None
@@ -894,13 +913,19 @@ class GBDT:
         return jnp.asarray(mask)
 
     def _sample_bagging_mask(self, iter_idx: int) -> jnp.ndarray:
-        """Row bagging (gbdt.cpp:180-241); resampled every bagging_freq."""
+        """Row bagging (gbdt.cpp:180-241); resampled every bagging_freq.
+        Ranking models bag whole query groups (one uniform per query,
+        broadcast through ``_row_group``)."""
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
             return self._apply_row_valid(self._bag_mask)
         if iter_idx % cfg.bagging_freq == 0:
             self._bag_key, sub = jax.random.split(self._bag_key)
-            u = jax.random.uniform(sub, (self.num_data,))
+            if self._row_group is not None:
+                u = jax.random.uniform(sub, (self._num_groups,))
+                u = u[self._row_group]
+            else:
+                u = jax.random.uniform(sub, (self.num_data,))
             self._bag_mask = (u < cfg.bagging_fraction).astype(jnp.float32)
         return self._apply_row_valid(self._bag_mask)
 
@@ -1460,6 +1485,8 @@ class GBDT:
         freq = max(cfg.bagging_freq, 1)
         frac = cfg.bagging_fraction
         row_valid = self._row_valid
+        row_group = self._row_group          # group-aware bagging (ranking)
+        num_groups = getattr(self, "_num_groups", 0)
 
         def run_block(xb, obj_rows, fp_capture, scores, feature_masks,
                       goss_actives, iter_idxs, keys, bag_mask0, cegb_state,
@@ -1472,10 +1499,15 @@ class GBDT:
                 fm, ga, it, key = xs
                 bkey, gkey = jax.random.split(key)
                 if bag_enabled:
-                    # bagging refresh on schedule (gbdt.cpp:180-241)
+                    # bagging refresh on schedule (gbdt.cpp:180-241);
+                    # ranking: one uniform per QUERY, broadcast to rows
                     refresh = (it % freq) == 0
-                    new_mask = (jax.random.uniform(bkey, (n,)) < frac) \
-                        .astype(jnp.float32)
+                    if row_group is not None:
+                        u = jax.random.uniform(bkey, (num_groups,))
+                        u = u[row_group]
+                    else:
+                        u = jax.random.uniform(bkey, (n,))
+                    new_mask = (u < frac).astype(jnp.float32)
                     bag_mask = jnp.where(refresh, new_mask, bag_mask)
                 sm = bag_mask if row_valid is None else bag_mask * row_valid
                 packed, _leaf_ids, sc2, cegb2, stopped2, health, ms = core(

@@ -101,6 +101,62 @@ class CheckpointManager:
         manifest.save()
         return entry
 
+    def save_refit(self, target, data_profile=None) -> Dict[str, Any]:
+        """Publish a REFIT snapshot: trees only (structure + re-estimated
+        leaf values), no resumable training state.
+
+        This is how the continuous-training loop (docs/Fleet.md) ships a
+        refitted model to the serving fleet: the snapshot gets the next
+        free id so ``latest_model`` — the CheckpointWatcher poll target —
+        hot-rolls it, while training resume (``load_latest``) SKIPS it
+        and keeps resuming from the last full training snapshot, so
+        checkpoint -> refit -> resume round-trips byte-stably.
+
+        ``data_profile`` (obs.drift.DataProfile, typically built from the
+        refit window) rides in the snapshot meta; the serving side picks
+        it up via the sibling-meta seam (serving/registry.py), which is
+        what makes post-refit drift scores recover.
+        """
+        impl = _impl_of(target)
+        if not getattr(impl, "models", None):
+            raise LightGBMError("save_refit: target has no trees")
+        os.makedirs(self.directory, exist_ok=True)
+        manifest = Manifest.load(self.directory) or Manifest(self.directory)
+
+        tree_meta, arrays = snap_mod.trees_to_arrays(impl.models)
+        k = max(int(getattr(impl, "num_tree_per_iteration", 1)), 1)
+        meta: Dict[str, Any] = {
+            "snapshot_version": snap_mod.SNAPSHOT_VERSION,
+            "refit": True,
+            "iteration": len(impl.models) // k,
+            "config_hash": snap_mod.config_hash(impl.config),
+            "unix_time": time.time(),
+            "trees": tree_meta,
+        }
+        if data_profile is not None:
+            meta["data_profile"] = data_profile.to_json_dict()
+
+        if hasattr(target, "model_to_string"):
+            model_text = target.model_to_string()
+        else:
+            from ..io import model_text as mt
+            ds = impl.train_data
+            model_text = mt.model_to_string(
+                impl, list(ds.feature_names), list(ds.get_feature_infos()))
+
+        snap_id = 1 + max((int(e["id"]) for e in manifest.entries),
+                          default=int(meta["iteration"]) - 1)
+        entry = snap_mod.write_snapshot(self.directory, snap_id, meta,
+                                        arrays, model_text)
+        entry["refit"] = True
+        entry["unix_time"] = meta["unix_time"]
+        manifest.entries = [e for e in manifest.entries
+                            if int(e["id"]) != snap_id]
+        manifest.add_entry(entry)
+        manifest.prune(self.keep_last_n)
+        manifest.save()
+        return entry
+
     @staticmethod
     def _flag_best(manifest: Manifest, entry: Dict[str, Any]) -> None:
         ev = entry.get("eval")
@@ -130,13 +186,19 @@ class CheckpointManager:
         manifest = Manifest.load(self.directory)
         if manifest is None or not manifest.entries:
             return None
-        entry = manifest.latest_valid_entry()
+        # refit snapshots (save_refit) are trees-only servables, not
+        # resumable training state — training resume skips them and picks
+        # up from the last FULL snapshot underneath
+        train_entries = [e for e in manifest.entries if not e.get("refit")]
+        if not train_entries:
+            return None
+        entry = manifest.latest_valid_entry(skip=lambda e: e.get("refit"))
         if entry is None:
             raise LightGBMError(
                 "checkpoint directory %s has a manifest with %d snapshot(s) "
                 "but none passed verification; refusing to silently start "
-                "over" % (self.directory, len(manifest.entries)))
-        if int(entry["id"]) != max(int(e["id"]) for e in manifest.entries):
+                "over" % (self.directory, len(train_entries)))
+        if int(entry["id"]) != max(int(e["id"]) for e in train_entries):
             Log.warning("checkpoint: resuming from snapshot %s (newer "
                         "snapshots failed verification)", entry["id"])
         meta, arrays, model_path = snap_mod.read_snapshot(self.directory,

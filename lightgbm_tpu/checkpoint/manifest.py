@@ -127,9 +127,14 @@ class Manifest:
                 return False
         return True
 
-    def latest_valid_entry(self) -> Optional[Dict[str, Any]]:
-        """Newest entry that verifies; corrupt tails are skipped loudly."""
+    def latest_valid_entry(self, skip=None) -> Optional[Dict[str, Any]]:
+        """Newest entry that verifies; corrupt tails are skipped loudly.
+        ``skip(entry) -> bool`` filters entries OUT silently first — how
+        training resume passes over refit snapshots (trees-only, no
+        resumable state) that serving hot-rolls happily."""
         for entry in sorted(self.entries, key=lambda e: -int(e["id"])):
+            if skip is not None and skip(entry):
+                continue
             if self.verify_entry(entry):
                 return entry
             Log.warning("checkpoint: falling back past corrupt snapshot %s",
@@ -138,7 +143,9 @@ class Manifest:
 
     def prune(self, keep_last_n: int) -> None:
         """Retention: keep the newest ``keep_last_n`` entries plus any entry
-        flagged best-so-far; delete the files of everything else."""
+        flagged best-so-far plus the newest FULL training snapshot (a run
+        of refit snapshots must never prune away the only resumable
+        state); delete the files of everything else."""
         if keep_last_n <= 0 or len(self.entries) <= keep_last_n:
             return
         ordered = sorted(self.entries, key=lambda e: -int(e["id"]))
@@ -148,6 +155,12 @@ class Manifest:
             if e.get("best"):
                 keep.append(e)
                 keep_ids.add(int(e["id"]))
+        if not any(not e.get("refit") for e in keep):
+            for e in ordered[keep_last_n:]:
+                if not e.get("refit"):
+                    keep.append(e)
+                    keep_ids.add(int(e["id"]))
+                    break
         for e in ordered:
             if int(e["id"]) in keep_ids:
                 continue

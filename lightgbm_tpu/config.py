@@ -360,6 +360,26 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("serve_guard_hot_roll", bool, True, ["serve_guarded_roll"]),
     ("serve_canary_rows", int, 16, []),
     ("serve_roll_max_latency_ms", float, 0.0, []),   # 0 = no latency gate
+    # structure-preserving refit (fleet/refit.py): device path for dense
+    # inputs (host numpy fallback for sparse / when disabled)
+    ("refit_device", bool, True, []),
+    # multi-model QoS (fleet/qos.py): default per-model queued-row quota
+    # (0 = engine-wide bound only) and "model=weight,..." weighted-fair
+    # scheduling weights (empty = every model weight 1; QoS engages when
+    # either is set)
+    ("serve_qos_quota_rows", int, 0, []),
+    ("serve_qos_weights", str, "", []),
+    # cascade-margin autotuning: hold observed per-bucket p99 under this
+    # budget by walking serving_cascade_margin down a geometric ladder
+    # (0 = autotune off; needs serving_cascade_trees > 0)
+    ("serve_latency_budget_ms", float, 0.0, []),
+    ("serve_qos_tune_interval_s", float, 2.0, []),
+    # serving fleet (fleet/replica.py): shared file-KV directory replicas
+    # announce generations/state through, this process' replica name, and
+    # the announce period (fleet engages when fleet_kv_dir is set)
+    ("fleet_kv_dir", str, "", []),
+    ("fleet_replica", str, "", []),
+    ("fleet_announce_period_s", float, 1.0, []),
 ]
 
 # known spellings, validated in _post_process (a typo'd kernel or growth
@@ -718,6 +738,25 @@ class Config:
             raise LightGBMError("serve_roll_max_latency_ms should be >= 0 "
                                 "(0 = no latency gate), got %s"
                                 % self.serve_roll_max_latency_ms)
+        if self.serve_qos_quota_rows < 0:
+            raise LightGBMError("serve_qos_quota_rows should be >= 0 "
+                                "(0 = engine-wide bound only), got %s"
+                                % self.serve_qos_quota_rows)
+        if self.serve_latency_budget_ms < 0:
+            raise LightGBMError("serve_latency_budget_ms should be >= 0 "
+                                "(0 = autotune off), got %s"
+                                % self.serve_latency_budget_ms)
+        if self.serve_latency_budget_ms > 0 and \
+                self.serving_cascade_trees <= 0:
+            raise LightGBMError(
+                "serve_latency_budget_ms needs serving_cascade_trees > 0 "
+                "(there is no early-exit cascade to autotune)")
+        if self.serve_qos_tune_interval_s <= 0:
+            raise LightGBMError("serve_qos_tune_interval_s should be > 0, "
+                                "got %s" % self.serve_qos_tune_interval_s)
+        if self.fleet_announce_period_s <= 0:
+            raise LightGBMError("fleet_announce_period_s should be > 0, "
+                                "got %s" % self.fleet_announce_period_s)
         # verbosity drives the process logger unconditionally so
         # verbosity=-1 (fatal-only) also silences obs warnings; previously
         # negative values were dropped and warnings leaked through

@@ -1,0 +1,31 @@
+"""lightgbm_tpu.fleet — the continuous-training serving fleet.
+
+The fourth runtime pillar next to train / serve / stream: everything
+needed to keep a SERVING model current without a full retrain, at fleet
+scale. Four layers (docs/Fleet.md):
+
+- **refit** (refit.py): structure-preserving leaf re-estimation on fresh
+  data — the reference's ``GBDT::RefitTree`` semantics executed as ONE
+  device pass (flat SoA leaf-id traversal + per-leaf segment sums inside
+  a ``lax.scan`` over boosting iterations), published as a checkpoint
+  snapshot so the result rides the existing hot-roll path.
+- **QoS** (qos.py): per-model admission quotas + weighted-fair
+  scheduling when several models share one engine, and closed-loop
+  cascade-margin autotuning against a latency budget.
+- **replicas** (replica.py): N serving processes kept converged through
+  a shared checkpoint dir + KV generation announcements, rolled one at a
+  time behind the canary-guarded ``stage_and_prewarm`` refusal path,
+  with fleet-wide state federated on ``/metrics/cluster``.
+- **the loop**: drift warn -> refit window -> snapshot -> rolling
+  hot-roll, exercised end-to-end by ``tools/fleet_smoke.py``.
+"""
+from .refit import Refitter, refit_booster
+from .qos import CascadeAutotuner, QosPolicy
+from .replica import (FileKvClient, FleetClusterProvider, ReplicaAnnouncer,
+                      RollingDeployCoordinator)
+
+__all__ = [
+    "Refitter", "refit_booster", "QosPolicy", "CascadeAutotuner",
+    "FileKvClient", "ReplicaAnnouncer", "RollingDeployCoordinator",
+    "FleetClusterProvider",
+]

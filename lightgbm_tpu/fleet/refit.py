@@ -1,0 +1,233 @@
+"""Structure-preserving refit: re-estimate leaf outputs on fresh data.
+
+The reference's continuous-training primitive (``GBDT::RefitTree``,
+gbdt.cpp:263-286 + ``FitByExistingTree``): every split of every tree is
+kept, only the leaf OUTPUTS are recomputed from the new data's gradients
+— orders of magnitude cheaper than retraining, and the serving side can
+hot-roll the result with zero structural churn (same traversal depth,
+same node tables, new leaf values).
+
+Device execution shape: the packed ``FlatForest`` (serving/traversal.py)
+routes ALL rows through ALL trees in one ``depth``-step traversal
+(``forest_leaf_ids`` — [N, T] leaf ids), then ONE jitted ``lax.scan``
+over boosting iterations refreshes gradients from the running scores and
+segment-sums grad/hess per leaf:
+
+    out  = -sign(G) * max(|G| - l1, 0) / (H + l2 + eps)    (per leaf)
+    leaf = decay * old + (1 - decay) * out * tree_shrinkage
+
+(CalculateSplittedLeafOutput, feature_histogram.hpp:454-462, then the
+RefitTree decay blend.) Per-tree shrinkage — including DART's per-tree
+weights — is preserved, and padded leaf slots keep their old values so a
+packed table never leaks refit math into rows that can't reach it.
+
+The compiled-program set is BOUNDED and tree-count-independent: one
+leaf-id traversal program + one scan program per (row-count, objective)
+signature, reused across refit cycles — the perf gate pins both the
+per-cycle program count and that a second cycle at the same shapes
+compiles NOTHING (obs/perfgate.py ``refit_*`` counters). Final stored
+leaf values are blended on host in float64 against the original doubles,
+so ``decay_rate=1.0`` is byte-stable (the tier-1 refit tests pin this).
+
+Host fallback: ``Booster.refit`` keeps the numpy path for sparse inputs;
+it is also the golden reference the device path is tested against.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..io.dataset import Metadata
+from ..log import check
+from ..serving.traversal import forest_leaf_ids, pack_flat_forest
+
+_EPS = 1e-15
+
+
+def _objective_arrays(obj) -> Dict[str, jnp.ndarray]:
+    """Every device-array attribute of an initialized objective — the
+    data-dependent state its ``get_gradients`` closes over (label,
+    weights, transformed labels, lambdarank's padded query tensors, ...).
+    Passing these as ARGUMENTS to the jitted refit core — re-bound onto
+    the objective inside the trace — keeps the compiled program reusable
+    across refit windows: fresh data of the same shapes hits the jit
+    cache instead of retracing."""
+    return {name: val for name, val in vars(obj).items()
+            if isinstance(val, jnp.ndarray)}
+
+
+class Refitter:
+    """Reusable device refitter bound to one model's structure.
+
+    Packs the forest once; each :meth:`refit` call routes a fresh data
+    window through it and returns a new ``Booster`` with identical tree
+    structures and re-estimated leaf values. Hold the instance across
+    cycles (the fleet refit worker does) to reuse the compiled programs.
+    """
+
+    def __init__(self, booster):
+        impl = booster._impl
+        check(impl is not None and impl.models,
+              "Cannot refit: no trained model")
+        check(booster._objective is not None,
+              "Cannot refit a model trained with a custom objective")
+        self._model_str = booster.model_to_string()
+        self._models = list(impl.models)
+        self.k = max(int(impl.num_tree_per_iteration), 1)
+        self.iterations = len(self._models) // self.k
+        forest, depth = pack_flat_forest(self._models)
+        self.depth = depth
+        self._forest = jax.tree.map(jnp.asarray, forest)
+        nleaves = forest.leaf_value.shape[1]
+        self._nl = np.array(
+            [int(getattr(t, "num_leaves_actual", t.num_leaves))
+             for t in self._models], np.int32)
+        # pre-pack the per-tree refit constants iteration-major [I, k, ...]
+        self._old64 = [np.asarray(t.leaf_value, np.float64)
+                       for t in self._models]
+        self._old_leaf = jnp.asarray(
+            forest.leaf_value.reshape(self.iterations, self.k, nleaves))
+        self._shrink = jnp.asarray(np.array(
+            [float(getattr(t, "shrinkage", 1.0)) for t in self._models],
+            np.float32).reshape(self.iterations, self.k))
+        self._mask = jnp.asarray(
+            (np.arange(nleaves)[None, :] < self._nl[:, None])
+            .reshape(self.iterations, self.k, nleaves))
+        cfg = booster.config
+        self._decay_default = float(cfg.refit_decay_rate)
+        self._l1 = float(cfg.lambda_l1)
+        self._l2 = float(cfg.lambda_l2)
+        self._mds = float(cfg.max_delta_step)
+        self._obj = copy.deepcopy(booster._objective)
+        self._core = None
+        # jitted once: an EAGER fori_loop re-traces per call (its body
+        # closure is a fresh function object each time), which would leak
+        # one compile per cycle; under jit the traversal is one cached
+        # program keyed on (forest pytree, rows, depth)
+        self._route = jax.jit(forest_leaf_ids, static_argnames="depth")
+
+    # ------------------------------------------------------------ core
+    def _raw_core(self):
+        """The un-jitted scan-over-iterations refit program; one gradient
+        refresh per boosting iteration from the running scores — the
+        identical refresh schedule as the host path (c == i % k == 0)."""
+        obj, k = self._obj, self.k
+        l1, l2, mds = self._l1, self._l2, self._mds
+
+        def core(leaves, old_leaf, shrink, mask, decay, attrs):
+            for name, val in attrs.items():
+                setattr(obj, name, val)
+            n = leaves.shape[-1]
+            nleaves = old_leaf.shape[-1]
+
+            def seg(lf, v):
+                return jnp.zeros((nleaves,), jnp.float32).at[lf].add(v)
+
+            def body(scores, xs):
+                lv, old, shr, msk = xs           # [k,N] [k,L] [k] [k,L]
+                if k == 1:
+                    g, h = obj.get_gradients(scores[:, 0])
+                    g, h = g.reshape(1, -1), h.reshape(1, -1)
+                else:
+                    g, h = obj.get_gradients(scores)
+                    g, h = g.T, h.T
+                sg = jax.vmap(seg)(lv, g)        # [k, L]
+                sh = jax.vmap(seg)(lv, h)
+                out = -jnp.sign(sg) * jnp.maximum(jnp.abs(sg) - l1, 0.0) \
+                    / (sh + l2 + _EPS)
+                if mds > 0:
+                    out = jnp.clip(out, -mds, mds)
+                out = out * shr[:, None]
+                new = jnp.where(msk, decay * old + (1.0 - decay) * out, old)
+                upd = jax.vmap(lambda nw, lf: nw[lf])(new, lv)   # [k, N]
+                return scores + upd.T, out
+
+            scores0 = jnp.zeros((n, k), jnp.float32)
+            _, outs = lax.scan(
+                body, scores0, (leaves, old_leaf, shrink, mask))
+            return outs                          # [I, k, L] pre-blend
+
+        return core
+
+    # ------------------------------------------------------------ refit
+    def refit(self, data, label, decay_rate: Optional[float] = None,
+              weight=None, group=None):
+        """One refit cycle: returns a NEW Booster, structure-identical to
+        the bound model, with leaf values re-estimated on ``data``."""
+        from ..basic import Booster, _to_1d, _to_2d_float
+
+        X = _to_2d_float(data)
+        n = X.shape[0]
+        decay = self._decay_default if decay_rate is None \
+            else float(decay_rate)
+        md = Metadata(n)
+        md.set_label(_to_1d(label))
+        if weight is not None:
+            md.set_weight(_to_1d(weight))
+        if group is not None:
+            md.set_query(np.asarray(group, np.int64))
+        self._obj.init(md, n)
+        attrs = _objective_arrays(self._obj)
+
+        leaves = self._route(self._forest, jnp.asarray(X, jnp.float32),
+                             depth=self.depth)                  # [N, T]
+        leaves = jnp.transpose(leaves).reshape(self.iterations, self.k, n)
+        if self._core is None:
+            self._core = jax.jit(self._raw_core())
+        outs = np.asarray(self._core(
+            leaves, self._old_leaf, self._shrink, self._mask,
+            jnp.float32(decay), attrs))
+
+        # stored values blend on HOST in f64 against the original doubles
+        # (the scan's f32 blend only feeds the in-flight score refresh):
+        # decay=1.0 reproduces the old leaf tables byte-for-byte
+        new_trees = []
+        for i, ht in enumerate(self._models):
+            it, c = divmod(i, self.k)
+            nl = self._nl[i]
+            nh = copy.deepcopy(ht)
+            nh.leaf_value = ht.leaf_value.copy()
+            nh.leaf_value[:nl] = decay * self._old64[i][:nl] \
+                + (1.0 - decay) * outs[it, c, :nl].astype(np.float64)
+            new_trees.append(nh)
+        refitted = Booster(model_str=self._model_str)
+        refitted._impl.models = new_trees
+        return refitted
+
+
+def refit_booster(booster, data, label, decay_rate: Optional[float] = None,
+                  weight=None, group=None):
+    """One-shot device refit (``Booster.refit`` dispatches here for dense
+    inputs); build a :class:`Refitter` directly to amortize packing and
+    compilation across repeated cycles."""
+    return Refitter(booster).refit(data, label, decay_rate=decay_rate,
+                                   weight=weight, group=group)
+
+
+def refit_audit_entry(booster, rows: int = 256
+                      ) -> Tuple[Any, Tuple[Any, ...]]:
+    """(fn, args) for the static-analysis gate: the refit core with
+    ShapeDtypeStruct arguments at ``rows`` synthetic rows, traceable by
+    ``jax.make_jaxpr`` without touching a device. Pins the program's
+    structural fingerprint — zero f64 primitives, zero collectives, zero
+    host callbacks — exactly like the serving predict entries."""
+    r = Refitter(booster)
+    md = Metadata(rows)
+    md.set_label(np.zeros(rows, np.float32))
+    r._obj.init(md, rows)
+    sds = jax.ShapeDtypeStruct
+    attrs = jax.tree_util.tree_map(
+        lambda a: sds(a.shape, a.dtype), _objective_arrays(r._obj))
+    nleaves = r._old_leaf.shape[-1]
+    args = (sds((r.iterations, r.k, rows), jnp.int32),
+            sds((r.iterations, r.k, nleaves), jnp.float32),
+            sds((r.iterations, r.k), jnp.float32),
+            sds((r.iterations, r.k, nleaves), jnp.bool_),
+            sds((), jnp.float32), attrs)
+    return r._raw_core(), args

@@ -45,6 +45,7 @@ class Metadata:
         self.weight: Optional[np.ndarray] = None
         self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1] int
         self.init_score: Optional[np.ndarray] = None
+        self._query_weights: Optional[np.ndarray] = None    # lazy cache
 
     def set_label(self, label: Sequence[float]) -> None:
         arr = np.ascontiguousarray(label, dtype=np.float32).reshape(-1)
@@ -60,6 +61,7 @@ class Metadata:
         arr = np.ascontiguousarray(weight, dtype=np.float32).reshape(-1)
         check(len(arr) == self.num_data, "Length of weight is not same with #data")
         self.weight = arr
+        self._query_weights = None
 
     def set_query(self, group: Optional[Sequence[int]]) -> None:
         """Accepts per-query sizes (LightGBM group format) -> boundaries."""
@@ -71,6 +73,7 @@ class Metadata:
         check(boundaries[-1] == self.num_data,
               "Sum of query counts is not same with #data")
         self.query_boundaries = boundaries.astype(np.int32)
+        self._query_weights = None
 
     def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
         if init_score is None:
@@ -82,6 +85,22 @@ class Metadata:
     @property
     def num_queries(self) -> int:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    @property
+    def query_weights(self) -> Optional[np.ndarray]:
+        """Per-query weight = MEAN of the query's document weights
+        (metadata.cpp LoadQueryWeights); None unless BOTH per-row weights
+        and query boundaries are set. Derived lazily so binary-cache loads
+        (which assign fields directly) and any set order all work."""
+        if self.weight is None or self.query_boundaries is None:
+            return None
+        if self._query_weights is None \
+                or len(self._query_weights) != self.num_queries:
+            qb = np.asarray(self.query_boundaries, np.int64)
+            sums = np.add.reduceat(self.weight.astype(np.float64), qb[:-1])
+            counts = np.maximum(np.diff(qb), 1)
+            self._query_weights = (sums / counts).astype(np.float32)
+        return self._query_weights
 
 
 def _parse_categorical(categorical_feature, feature_names: List[str]) -> List[int]:

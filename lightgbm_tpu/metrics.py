@@ -290,8 +290,16 @@ class _QueryMetric(Metric):
               "query information required for ranking metric")
         self.qb = np.asarray(metadata.query_boundaries, np.int64)
         self.num_queries = len(self.qb) - 1
-        self.query_weights = None  # per-query weights not wired yet
-        self.sum_query_weights = float(self.num_queries)
+        # per-query weights (metadata.cpp LoadQueryWeights: mean of the
+        # query's document weights) — weighted queries contribute
+        # proportionally to the metric, exactly rank_metric.hpp's
+        # query_weights_ / sum_query_weights_ accumulation
+        qw = metadata.query_weights
+        self.query_weights = (None if qw is None
+                              else np.asarray(qw, np.float64))
+        self.sum_query_weights = (float(self.num_queries)
+                                  if self.query_weights is None
+                                  else float(self.query_weights.sum()))
 
     def per_query(self, y: np.ndarray, s: np.ndarray) -> List[float]:
         raise NotImplementedError
@@ -301,7 +309,9 @@ class _QueryMetric(Metric):
         totals = np.zeros(len(self.eval_at))
         for q in range(self.num_queries):
             lo, hi = self.qb[q], self.qb[q + 1]
-            totals += np.asarray(self.per_query(self.label[lo:hi], score[lo:hi]))
+            pq = np.asarray(self.per_query(self.label[lo:hi], score[lo:hi]))
+            totals += pq if self.query_weights is None \
+                else self.query_weights[q] * pq
         return list(totals / self.sum_query_weights)
 
 

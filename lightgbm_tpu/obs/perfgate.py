@@ -260,7 +260,44 @@ def measure(workload: Optional[Dict[str, Any]] = None
             counters["wave_payload_f32_" + suffix] = wave[1]
     counters.update(_stream_counters(wl))
     counters.update(_packing_counters())
+    counters.update(_refit_counters(bst, wl))
     return counters, wl
+
+
+def _refit_counters(bst, wl: Dict[str, Any]) -> Dict[str, Any]:
+    """Structure-preserving refit (fleet/refit.py): the compiled-program
+    contract of the continuous-training loop. A Refitter's first cycle
+    compiles a BOUNDED set of programs (the leaf-id traversal + the
+    scan-over-iterations core — tree-count-independent); a second cycle
+    on a fresh window of the SAME shapes must compile NOTHING (the
+    objective's device arrays are jit arguments, so new data hits the
+    cache). Both are exact: a new compile here means someone broke the
+    per-cycle reuse the fleet refit worker depends on."""
+    import numpy as np
+
+    from ..fleet.refit import Refitter
+    from ..profiling import backend_compile_count
+
+    rng = np.random.RandomState(int(wl["seed"]) + 1)
+    nf = int(wl["features"])
+
+    def window():
+        X = rng.randn(512, nf).astype(np.float32)
+        return X, (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+
+    r = Refitter(bst)
+    counters: Dict[str, Any] = {}
+    X, y = window()
+    c0 = backend_compile_count()
+    r.refit(X, y)
+    counters["refit_programs_first_cycle"] = float(
+        backend_compile_count() - c0)
+    X, y = window()
+    c1 = backend_compile_count()
+    r.refit(X, y)
+    counters["refit_compiles_second_cycle"] = float(
+        backend_compile_count() - c1)
+    return counters
 
 
 def _packing_counters() -> Dict[str, Any]:

@@ -195,17 +195,27 @@ class ServingEngine:
                 from ..obs.drift import unregister_monitor
                 unregister_monitor(model_id)
 
+    def _active_margin(self) -> float:
+        """The cascade margin live entries are compiled against; part of
+        the cache key so a retune (set_cascade_margin) can build the new
+        margin's entries while the old ones keep serving. Constant 0.0
+        when no cascade is configured — margin writes then never churn
+        the cache."""
+        return float(self.cascade_margin) if self.cascade_trees > 0 else 0.0
+
     def _predictor(self, bundle: ModelBundle, bucket: int, raw_score: bool,
                    iters: int) -> _CompiledPredictor:
+        margin = self._active_margin()
         key = (bundle.model_id, getattr(bundle, "generation", 0), bucket,
-               bool(raw_score), iters)
+               bool(raw_score), iters, margin)
         with self._lock:
             entry = self._cache.get(key)
             if entry is None:
                 entry = _CompiledPredictor(
                     bundle, bucket, raw_score, iters, mesh=self.mesh,
                     backend=self.backend, cascade_trees=self.cascade_trees,
-                    cascade_margin=self.cascade_margin,
+                    cascade_margin=margin if self.cascade_trees > 0
+                    else self.cascade_margin,
                     quantize_leaves=self.quantize_leaves)
                 self._cache[key] = entry
                 hit = False
@@ -217,6 +227,36 @@ class ServingEngine:
     def cache_size(self) -> int:
         with self._lock:
             return len(self._cache)
+
+    def set_cascade_margin(self, margin: float) -> int:
+        """Retune the early-exit cascade margin OFF the request path (the
+        fleet CascadeAutotuner's apply hook): compile + execute every
+        bucket at the new margin inside a warmup-credit window — exactly
+        the ``stage_and_prewarm`` accounting, so the zero-recompile
+        serving invariant survives the retune — then purge the old
+        margin's entries. Returns the number of entries re-warmed (0 for
+        a no-op or when no cascade is configured)."""
+        margin = float(margin)
+        check(margin >= 0, "cascade margin must be >= 0, got %s" % margin)
+        if self.cascade_trees <= 0 or margin == self.cascade_margin:
+            self.cascade_margin = margin
+            return 0
+        from ..profiling import backend_compile_count
+        c0 = backend_compile_count()
+        m0 = self.metrics.cache_misses
+        self.cascade_margin = margin
+        warmed = 0
+        try:
+            for mid in self.registry.ids():
+                warmed += self._warm_bundle(self.registry.get(mid),
+                                            (False,), (None,))
+        finally:
+            self.metrics.add_warmup_credit(backend_compile_count() - c0,
+                                           self.metrics.cache_misses - m0)
+        with self._lock:
+            for key in [k for k in self._cache if k[5] != margin]:
+                del self._cache[key]
+        return warmed
 
     # ------------------------------------------------------------ drift
     def drift_monitor(self, bundle: ModelBundle):

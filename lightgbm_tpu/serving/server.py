@@ -65,6 +65,13 @@ class ServingApp:
         self.engine = engine
         self.queue = queue if queue is not None else MicroBatchQueue(engine)
         self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # fleet attachments (docs/Fleet.md), wired by build_app when the
+        # matching config is set; all optional and None in the plain app
+        self.tuner = None          # fleet.qos.CascadeAutotuner
+        self.announcer = None      # fleet.replica.ReplicaAnnouncer
+        self.coordinator = None    # fleet.replica.RollingDeployCoordinator
+        self.watcher = None        # serving.registry.CheckpointWatcher
+        self.cluster = None        # fleet.replica.FleetClusterProvider
         self.queue.start()
 
     # ------------------------------------------------------------ requests
@@ -113,6 +120,11 @@ class ServingApp:
         return {"models": models}
 
     def close(self) -> None:
+        for part in (self.coordinator, self.announcer, self.tuner):
+            if part is not None:
+                part.stop()
+        if self.watcher is not None:
+            self.watcher.stop()
         self.queue.stop()
 
 
@@ -153,6 +165,29 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/stats":
             snap = self.app.engine.metrics.snapshot()
             snap["breaker"] = self.app.breaker.snapshot()
+            snap["queue"] = self.app.queue.stats()
+            if self.app.tuner is not None:
+                snap["cascade_autotune"] = self.app.tuner.snapshot()
+            if self.app.announcer is not None:
+                # the full announced document, not just the name: /stats
+                # is how an operator checks what THIS replica is telling
+                # the fleet (snap_id, rejections, digest)
+                snap["replica"] = self.app.announcer.state()
+            self._reply(200, snap)
+        elif self.path == "/metrics/cluster":
+            # fleet federation (docs/Fleet.md): merged per-replica gauges
+            # from the KV namespace; without a fleet, the local registry
+            # (the single-replica degenerate case, like obs StatsServer)
+            text = (self.app.cluster.cluster_prometheus()
+                    if self.app.cluster is not None
+                    else get_registry().prometheus_text())
+            self._reply_raw(200, text.encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/stats/cluster":
+            snap = (self.app.cluster.cluster_stats()
+                    if self.app.cluster is not None
+                    else {"fleet": {"replicas": 0, "live": 0},
+                          "replicas": {}})
             self._reply(200, snap)
         elif self.path == "/metrics":
             self._reply(200, self.app.engine.metrics.snapshot())
@@ -235,7 +270,14 @@ def _metrics_writer(metrics: ServingMetrics, path: str, freq_s: float,
 
 def build_app(config: Config) -> ServingApp:
     """Engine + queue from serve_* config; loads ``input_model`` (if any)
-    under id "default" — tests/embedders register models themselves."""
+    under id "default" — tests/embedders register models themselves.
+
+    Fleet wiring (docs/Fleet.md), each independently optional:
+    ``serve_qos_*`` puts a QosPolicy on the queue;
+    ``serve_latency_budget_ms`` starts the cascade-margin autotuner;
+    ``fleet_kv_dir`` makes this process an announced replica (named
+    ``fleet_replica``) and — when ``checkpoint_dir`` is also set — a
+    participant in rolling deploys of that directory's snapshots."""
     if config.fault_inject:
         from ..resilience import faults
         faults.install_plan(config.fault_inject, config.fault_seed)
@@ -255,14 +297,43 @@ def build_app(config: Config) -> ServingApp:
         drift_decay=config.obs_drift_decay)
     if config.input_model:
         engine.registry.load_file("default", config.input_model)
+    qos = None
+    if config.serve_qos_weights or config.serve_qos_quota_rows:
+        from ..fleet.qos import QosPolicy
+        qos = QosPolicy.from_spec(config.serve_qos_weights,
+                                  config.serve_qos_quota_rows)
     app = ServingApp(
         engine,
         MicroBatchQueue(engine, deadline_ms=config.serve_deadline_ms,
                         max_queue_rows=config.serve_max_queue_rows,
-                        request_timeout_ms=config.serve_request_timeout_ms),
+                        request_timeout_ms=config.serve_request_timeout_ms,
+                        qos=qos),
         breaker=CircuitBreaker(
             failure_threshold=config.serve_breaker_failures,
             cooldown_s=config.serve_breaker_cooldown_s))
+    if config.serve_latency_budget_ms > 0:
+        from ..fleet.qos import CascadeAutotuner
+        app.tuner = CascadeAutotuner(
+            engine, config.serve_latency_budget_ms,
+            interval_s=config.serve_qos_tune_interval_s).start()
+    if config.fleet_kv_dir:
+        from ..fleet.replica import (FileKvClient, FleetClusterProvider,
+                                     ReplicaAnnouncer,
+                                     RollingDeployCoordinator)
+        client = FileKvClient(config.fleet_kv_dir)
+        replica = config.fleet_replica or ("replica-%d" % os.getpid())
+        if config.checkpoint_dir:
+            # the watcher is DRIVEN by the coordinator (one replica rolls
+            # at a time); its own poll thread stays off
+            app.watcher = engine.registry.watch_dir(
+                "default", config.checkpoint_dir, engine=engine)
+        app.announcer = ReplicaAnnouncer(
+            client, replica, engine=engine, watcher=app.watcher,
+            period_s=config.fleet_announce_period_s).start()
+        if app.watcher is not None:
+            app.coordinator = RollingDeployCoordinator(
+                client, app.announcer, app.watcher).start()
+        app.cluster = FleetClusterProvider(client)
     return app
 
 

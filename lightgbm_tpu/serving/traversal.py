@@ -71,7 +71,11 @@ def _tree_depth(left: np.ndarray, right: np.ndarray) -> int:
     """Traversal steps needed for one tree: the max count of internal
     nodes on any root-to-leaf path (>= 1; a stump still takes one step to
     follow ``~0`` to leaf 0). Iterative — trees can be chain-shaped."""
-    if len(left) == 0 or left[0] < 0:
+    # NOTE: only a truly empty tree short-circuits. A root whose LEFT
+    # child is a leaf is NOT a stump — its right subtree can be
+    # arbitrarily deep (sparse-trained chain trees look exactly like
+    # this), and under-counting depth freezes traversal mid-tree.
+    if len(left) == 0:
         return 1
     depth = 1
     stack: List[Tuple[int, int]] = [(0, 1)]
@@ -138,10 +142,11 @@ def pack_flat_forest(models, quantize: bool = False
                       leaf_scale=leaf_scale), depth
 
 
-def _leaf_values(forest: FlatForest, x: jnp.ndarray,
-                 depth: int) -> jnp.ndarray:
-    """[N, T] per-tree leaf values: all rows x all trees, ``depth``
-    breadth-first steps of gather + decide + follow-child."""
+def _terminal_nodes(forest: FlatForest, x: jnp.ndarray,
+                    depth: int) -> jnp.ndarray:
+    """[N, T] terminal encoded nodes (``~leaf_index``, all negative after
+    ``depth`` steps): all rows x all trees, breadth-first gather + decide
+    + follow-child."""
     n = x.shape[0]
     tcount = forest.left.shape[0]
     tr = jnp.arange(tcount, dtype=jnp.int32)[None, :]        # [1, T]
@@ -162,8 +167,27 @@ def _leaf_values(forest: FlatForest, x: jnp.ndarray,
         nxt = jnp.where(go_left, forest.left[tr, idx], forest.right[tr, idx])
         return jnp.where(internal, nxt, node)
 
-    node = lax.fori_loop(0, depth, step,
+    return lax.fori_loop(0, depth, step,
                          jnp.zeros((n, tcount), jnp.int32))
+
+
+def forest_leaf_ids(forest: FlatForest, x: jnp.ndarray,
+                    depth: int) -> jnp.ndarray:
+    """[N, T] int32 leaf index each row lands on in each tree — the
+    routing half of the traversal without the leaf-table gather. This is
+    the refit primitive (fleet/refit.py): leaf ids feed per-leaf
+    segment-sums of gradients, so leaf OUTPUTS can be recomputed on fresh
+    data while the structure that produced the ids stays frozen."""
+    return ~_terminal_nodes(forest, x, depth)
+
+
+def _leaf_values(forest: FlatForest, x: jnp.ndarray,
+                 depth: int) -> jnp.ndarray:
+    """[N, T] per-tree leaf values: all rows x all trees, ``depth``
+    breadth-first steps of gather + decide + follow-child."""
+    tcount = forest.left.shape[0]
+    tr = jnp.arange(tcount, dtype=jnp.int32)[None, :]        # [1, T]
+    node = _terminal_nodes(forest, x, depth)
     vals = forest.leaf_value[tr, ~node]                      # [N, T]
     if forest.leaf_value.dtype != jnp.float32:               # quantized table
         vals = vals.astype(jnp.float32) * forest.leaf_scale[None, :]

@@ -1,0 +1,416 @@
+"""lightgbm_tpu.fleet — refit, multi-model QoS, replicated rolling deploys.
+
+Contracts pinned here:
+- the device refit (fleet/refit.py) matches the host numpy golden path,
+  preserves every tree structure bit-for-bit, and is BYTE-stable at
+  decay_rate=1.0 (the f64 host blend against the original doubles);
+- checkpoint -> refit -> resume: ``save_refit`` snapshots are what
+  ``latest_model`` serves (the hot-roll poll target) and what
+  ``load_latest`` SKIPS (training resume), and retention never prunes
+  the only full training snapshot out from under a run of refits;
+- QosPolicy: per-model quotas shed only the offending tenant; the
+  weighted-fair pick converges served rows to the weight ratio;
+- CascadeAutotuner: one ladder rung per step, fresh-sample gating,
+  headroom hysteresis;
+- FileKvClient satisfies the KvHostComm client seam, including the
+  DEADLINE_EXCEEDED timeout marker;
+- ReplicaAnnouncer / RollingDeployCoordinator: lease-based liveness,
+  sorted-name turn-taking, and a canary rejection that propagates
+  fleet-wide without any successor ever staging the bad snapshot.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.checkpoint.manager import CheckpointManager
+from lightgbm_tpu.fleet import (CascadeAutotuner, FileKvClient,
+                                FleetClusterProvider, QosPolicy,
+                                RollingDeployCoordinator, ReplicaAnnouncer,
+                                Refitter, refit_booster)
+from lightgbm_tpu.serving import ModelRegistry
+
+from conftest import make_binary, make_multiclass
+
+
+def _binary_booster(n=500, rounds=8, seed=3):
+    X, y = make_binary(n=n, f=6, seed=seed)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15, "learning_rate": 0.2},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return bst, X, y
+
+
+def _leaf_tables(booster):
+    return [np.asarray(t.leaf_value, np.float64).copy()
+            for t in booster._impl.models]
+
+
+def _structure(booster):
+    return [(np.asarray(t.split_feature).tobytes(),
+             np.asarray(t.threshold).tobytes(),
+             np.asarray(t.left_child).tobytes(),
+             np.asarray(t.right_child).tobytes())
+            for t in booster._impl.models]
+
+
+# ------------------------------------------------------------------ refit
+def test_refit_decay_one_is_byte_stable():
+    """decay_rate=1.0 keeps every stored leaf double bit-for-bit: the
+    final blend happens on host in f64 against the original values."""
+    bst, X, y = _binary_booster()
+    refitted = bst.refit(X, y, decay_rate=1.0)
+    for old, new in zip(_leaf_tables(bst), _leaf_tables(refitted)):
+        np.testing.assert_array_equal(old, new)
+
+
+def test_refit_device_matches_host_golden_binary():
+    bst, X, y = _binary_booster()
+    rng = np.random.RandomState(0)
+    Xw = X + 0.3 * rng.randn(*X.shape)
+    dev = refit_booster(bst, Xw, y, decay_rate=0.4)
+    bst.config.refit_device = False       # force the host numpy path
+    host = bst.refit(Xw, y, decay_rate=0.4)
+    for a, b in zip(_leaf_tables(dev), _leaf_tables(host)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dev.predict(X[:100]), host.predict(X[:100]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_refit_device_matches_host_golden_multiclass():
+    """k>1 exercises the [N,k] gradient layout inside the scan body."""
+    X, y = make_multiclass(n=400, f=6, k=3, seed=5)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    dev = refit_booster(bst, X, y, decay_rate=0.0)
+    bst.config.refit_device = False
+    host = bst.refit(X, y, decay_rate=0.0)
+    for a, b in zip(_leaf_tables(dev), _leaf_tables(host)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_refit_shifted_data_changes_only_leaf_tables():
+    bst, X, y = _binary_booster()
+    refitted = bst.refit(X + 0.5, 1.0 - y, decay_rate=0.0)
+    assert _structure(refitted) == _structure(bst)
+    changed = sum(not np.array_equal(a, b) for a, b in
+                  zip(_leaf_tables(bst), _leaf_tables(refitted)))
+    assert changed == len(bst._impl.models)
+    assert not np.allclose(refitted.predict(X[:50]), bst.predict(X[:50]))
+
+
+def test_refitter_reuse_matches_one_shot():
+    """A held Refitter (the fleet worker pattern) gives the same answer
+    as a fresh one-shot refit, across cycles with different windows."""
+    bst, X, y = _binary_booster()
+    r = Refitter(bst)
+    for seed in (1, 2):
+        rng = np.random.RandomState(seed)
+        Xw = X + 0.2 * rng.randn(*X.shape)
+        held = r.refit(Xw, y, decay_rate=0.3)
+        shot = refit_booster(bst, Xw, y, decay_rate=0.3)
+        for a, b in zip(_leaf_tables(held), _leaf_tables(shot)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_refit_weight_changes_leaf_values():
+    bst, X, y = _binary_booster()
+    w = np.where(y > 0, 5.0, 1.0)
+    plain = bst.refit(X, y, decay_rate=0.0)
+    weighted = bst.refit(X, y, decay_rate=0.0, weight=w)
+    assert any(not np.array_equal(a, b) for a, b in
+               zip(_leaf_tables(plain), _leaf_tables(weighted)))
+
+
+# ------------------------------------------------------- checkpoint seam
+def test_checkpoint_refit_resume_byte_stable(tmp_path):
+    """save -> save_refit -> latest_model serves the refit; load_latest
+    resumes the FULL snapshot with its model text byte-identical."""
+    bst, X, y = _binary_booster()
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    mgr.save(bst)
+    full_text = bst.model_to_string()
+    full_id, _ = mgr.latest_model()
+
+    refitted = bst.refit(X + 0.5, y, decay_rate=0.0)
+    entry = mgr.save_refit(refitted)
+    assert entry["refit"] is True
+    assert int(entry["id"]) > full_id
+
+    snap_id, model_path = mgr.latest_model()
+    assert snap_id == int(entry["id"])     # serving hot-rolls the refit
+    served = lgb.Booster(model_file=model_path)
+    for a, b in zip(_leaf_tables(served), _leaf_tables(refitted)):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
+
+    handle = mgr.load_latest()             # training resume skips it
+    assert int(handle.entry["id"]) == full_id
+    assert not handle.entry.get("refit")
+    with open(handle.model_path) as fh:
+        assert fh.read() == full_text
+
+
+def test_refit_retention_keeps_last_full_snapshot(tmp_path):
+    """A run of refit snapshots must never prune the only resumable
+    training state out of the manifest."""
+    bst, X, y = _binary_booster(rounds=4)
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    mgr.save(bst)
+    full_id, _ = mgr.latest_model()
+    for shift in (0.1, 0.2, 0.3, 0.4):
+        mgr.save_refit(bst.refit(X + shift, y, decay_rate=0.0))
+    handle = mgr.load_latest()
+    assert handle is not None and int(handle.entry["id"]) == full_id
+    # and the newest refit still serves
+    assert mgr.latest_model()[0] > full_id
+
+
+def test_refit_only_directory_resumes_fresh(tmp_path):
+    bst, X, y = _binary_booster(rounds=3)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save_refit(bst.refit(X, y, decay_rate=0.5))
+    assert mgr.load_latest() is None       # nothing resumable: fresh start
+    assert mgr.latest_model() is not None  # but the model still serves
+
+
+# ---------------------------------------------------------------- QoS
+def test_qos_from_spec_and_quota_admission():
+    qos = QosPolicy.from_spec("gold=4, bronze=1", quota_rows=100)
+    assert qos.weight("gold") == 4.0
+    assert qos.weight("unknown") == 1.0
+    assert qos.quota("gold") == 100
+    assert qos.admit("gold", 90, 10)         # exactly at quota: admitted
+    assert not qos.admit("gold", 91, 10)     # over: shed, counted
+    assert qos.snapshot()["gold"]["shed"] == 1
+    with pytest.raises(Exception):
+        QosPolicy.from_spec("missing-equals")
+
+
+def test_qos_quota_sheds_only_offending_model():
+    qos = QosPolicy(quota_rows={"noisy": 10})
+    assert not qos.admit("noisy", 10, 1)
+    assert qos.admit("quiet", 10_000, 64)    # unlisted model: no quota
+
+
+def test_qos_weighted_fair_pick_converges_to_weights():
+    qos = QosPolicy(weights={"gold": 4.0, "bronze": 1.0})
+    served = {"gold": 0, "bronze": 0}
+    queued = {"gold": 64, "bronze": 64}      # both always have work
+    for _ in range(200):
+        mid = qos.pick(queued)
+        qos.account(mid, 32)
+        served[mid] += 32
+    ratio = served["gold"] / max(served["bronze"], 1)
+    assert 3.0 < ratio < 5.0
+
+
+def test_qos_new_model_starts_at_floor():
+    """A late-arriving model must not get an unbounded catch-up burst."""
+    qos = QosPolicy()
+    qos.account("old", 10_000)
+    qos.pick({"new": 1})                     # seen for the first time
+    qos.account("new", 1)
+    assert qos._served_rows["new"] >= 10_000
+
+
+class _FakeTunerEngine:
+    def __init__(self, margin=0.8):
+        self.cascade_trees = 4
+        self.cascade_margin = margin
+        self.applied = []
+        self.metrics = self
+        self.lat = {}
+
+    def bucket_latency(self):
+        return self.lat
+
+    def set_cascade_margin(self, m):
+        self.applied.append(m)
+
+
+def test_cascade_autotuner_walks_ladder_one_rung_per_step():
+    eng = _FakeTunerEngine(margin=0.8)
+    tuner = CascadeAutotuner(eng, budget_ms=10.0, rungs=3, min_samples=5)
+    assert tuner.step() is None              # no samples at all
+    eng.lat = {16: {"count": 10, "p99_ms": 50.0}}
+    assert tuner.step() == pytest.approx(0.4)   # one rung down, not two
+    assert tuner.step() is None              # same counts: no FRESH samples
+    eng.lat = {16: {"count": 20, "p99_ms": 50.0}}
+    assert tuner.step() == pytest.approx(0.2)   # bottom rung
+    eng.lat = {16: {"count": 30, "p99_ms": 50.0}}
+    assert tuner.step() is None              # already at the bottom
+    eng.lat = {16: {"count": 40, "p99_ms": 2.0}}
+    assert tuner.step() == pytest.approx(0.4)   # headroom: back up
+    eng.lat = {16: {"count": 50, "p99_ms": 8.0}}
+    assert tuner.step() is None              # inside hysteresis band
+    assert eng.applied == [pytest.approx(0.4), pytest.approx(0.2),
+                           pytest.approx(0.4)]
+    assert tuner.snapshot()["retunes"] == 3
+
+
+# ------------------------------------------------------------ FileKvClient
+def test_file_kv_client_contract(tmp_path):
+    kv = FileKvClient(str(tmp_path))
+    kv.key_value_set("fleet/a", "one")       # slash in the key is fine
+    assert kv.blocking_key_value_get("fleet/a", 100) == "one"
+    kv.key_value_set("fleet/a", "two")       # overwrite
+    assert kv.try_get("fleet/a") == "two"
+    assert kv.try_get("missing") is None
+    kv.key_value_set("fleet/b", "x")
+    kv.key_value_set("other", "y")
+    assert kv.keys("fleet/") == ["fleet/a", "fleet/b"]
+    kv.key_value_delete("fleet/a")
+    kv.key_value_delete("fleet/a")           # idempotent
+    assert kv.try_get("fleet/a") is None
+
+
+def test_file_kv_client_timeout_is_deadline_exceeded(tmp_path):
+    """The KvHostComm transient-vs-fatal marker: timeouts MUST carry
+    DEADLINE_EXCEEDED in the message (parallel/network.py _transient)."""
+    kv = FileKvClient(str(tmp_path), poll_interval_s=0.01)
+    with pytest.raises(Exception, match="DEADLINE_EXCEEDED"):
+        kv.blocking_key_value_get("never", timeout_ms=50)
+
+
+def test_file_kv_client_blocking_get_sees_concurrent_set(tmp_path):
+    kv = FileKvClient(str(tmp_path), poll_interval_s=0.005)
+    t = threading.Timer(0.05, kv.key_value_set, args=("late", "value"))
+    t.start()
+    try:
+        assert kv.blocking_key_value_get("late", 2000) == "value"
+    finally:
+        t.cancel()
+
+
+# ------------------------------------------------------------ announcer
+def test_announcer_roundtrip_lease_and_retract(tmp_path):
+    kv = FileKvClient(str(tmp_path))
+    ann = ReplicaAnnouncer(kv, "replica-a")
+    doc = ann.announce_once()
+    assert doc["replica"] == "replica-a" and doc["pid"] == os.getpid()
+    # a replica that stopped announcing long ago is leased out
+    stale = {"replica": "replica-b", "time": time.time() - 100}
+    kv.key_value_set("fleet/replica-b", json.dumps(stale))
+    kv.key_value_set("fleet/replica-c", "{not json")   # torn write: skipped
+    fleet = ReplicaAnnouncer.read_fleet(kv, lease_s=10.0)
+    assert fleet["replica-a"]["live"] is True
+    assert fleet["replica-b"]["live"] is False
+    assert "replica-c" not in fleet
+    ann.retract()
+    assert "replica-a" not in ReplicaAnnouncer.read_fleet(kv)
+
+
+def _fleet_fixture(tmp_path, name):
+    """One replica's registry/watcher/announcer over a shared KV dir."""
+    kv = FileKvClient(str(tmp_path / "kv"))
+    registry = ModelRegistry()
+    watcher = registry.watch_dir("default", str(tmp_path / "ckpt"))
+    ann = ReplicaAnnouncer(kv, name, watcher=watcher)
+    return kv, registry, watcher, ann
+
+
+def test_rolling_deploy_first_replica_rolls_immediately(tmp_path):
+    bst, _, _ = _binary_booster(rounds=3)
+    CheckpointManager(str(tmp_path / "ckpt")).save(bst)
+    kv, registry, watcher, ann = _fleet_fixture(tmp_path, "a")
+    coord = RollingDeployCoordinator(kv, ann, watcher,
+                                     predecessor_timeout_s=5.0)
+    assert coord.step() is True
+    assert "default" in registry.ids()
+    assert watcher._last_id >= 0
+    # the roll was announced (unblocks successors without waiting a period)
+    fleet = ReplicaAnnouncer.read_fleet(kv)
+    assert fleet["a"]["snap_id"] == watcher._last_id
+    assert coord.step() is False             # nothing new: no-op
+
+
+def test_rolling_deploy_waits_for_predecessor_then_rolls(tmp_path):
+    bst, _, _ = _binary_booster(rounds=3)
+    CheckpointManager(str(tmp_path / "ckpt")).save(bst)
+    kv, registry, watcher, ann = _fleet_fixture(tmp_path, "b")
+    target = CheckpointManager(str(tmp_path / "ckpt")).latest_model()[0]
+    # live predecessor "a" still serving an older snapshot: not ready
+    kv.key_value_set("fleet/a", json.dumps(
+        {"replica": "a", "time": time.time(), "snap_id": target - 1}))
+    coord = RollingDeployCoordinator(kv, ann, watcher,
+                                     poll_interval_s=0.02,
+                                     predecessor_timeout_s=30.0)
+    ready, rejected_by = coord._predecessors_ready(target)
+    assert not ready and rejected_by is None
+    # predecessor announces the target mid-wait -> we roll
+    t = threading.Timer(0.05, kv.key_value_set, args=("fleet/a", json.dumps(
+        {"replica": "a", "time": time.time(), "snap_id": target})))
+    t.start()
+    try:
+        assert coord.step() is True
+    finally:
+        t.cancel()
+    assert "default" in registry.ids()
+
+
+def test_rolling_deploy_dead_predecessor_cannot_block(tmp_path):
+    bst, _, _ = _binary_booster(rounds=3)
+    CheckpointManager(str(tmp_path / "ckpt")).save(bst)
+    kv, registry, watcher, ann = _fleet_fixture(tmp_path, "b")
+    target = CheckpointManager(str(tmp_path / "ckpt")).latest_model()[0]
+    kv.key_value_set("fleet/a", json.dumps(
+        {"replica": "a", "time": time.time() - 100, "snap_id": -1}))
+    coord = RollingDeployCoordinator(kv, ann, watcher,
+                                     predecessor_timeout_s=30.0)
+    assert coord._predecessors_ready(target) == (True, None)
+    assert coord.step() is True
+
+
+def test_rolling_deploy_canary_rejection_propagates(tmp_path):
+    """A predecessor's announced rejection means this replica NEVER
+    stages the snapshot — the fleet-wide canary contract."""
+    bst, _, _ = _binary_booster(rounds=3)
+    CheckpointManager(str(tmp_path / "ckpt")).save(bst)
+    kv, registry, watcher, ann = _fleet_fixture(tmp_path, "c")
+    target = CheckpointManager(str(tmp_path / "ckpt")).latest_model()[0]
+    kv.key_value_set("fleet/a", json.dumps(
+        {"replica": "a", "time": time.time(), "snap_id": -1,
+         "rejected": [target]}))
+    coord = RollingDeployCoordinator(kv, ann, watcher,
+                                     predecessor_timeout_s=30.0)
+    assert coord.step() is False
+    assert target in watcher._rejected_ids
+    assert "default" not in registry.ids()   # never staged, never registered
+    # the propagated rejection is itself announced for replicas after "c"
+    assert target in ReplicaAnnouncer.read_fleet(kv)["c"]["rejected"]
+    assert coord.step() is False             # and it stays skipped
+
+
+# ------------------------------------------------------- cluster provider
+def test_fleet_cluster_provider_stats_and_prometheus(tmp_path):
+    kv = FileKvClient(str(tmp_path))
+    now = time.time()
+    kv.key_value_set("fleet/a", json.dumps(
+        {"replica": "a", "time": now, "snap_id": 3,
+         "metrics": {"requests": 10, "shed": 1}}))
+    kv.key_value_set("fleet/b", json.dumps(
+        {"replica": "b", "time": now, "snap_id": 4,
+         "metrics": {"requests": 5, "shed": 0}}))
+    kv.key_value_set("fleet/c", json.dumps(
+        {"replica": "c", "time": now - 100, "snap_id": 2,
+         "metrics": {"requests": 99, "shed": 9}}))   # dead: excluded
+    prov = FleetClusterProvider(kv, lease_s=10.0)
+    stats = prov.cluster_stats()
+    assert stats["fleet"]["replicas"] == 3
+    assert stats["fleet"]["live"] == 2
+    assert stats["fleet"]["requests"] == 15
+    assert stats["fleet"]["shed"] == 1
+    assert stats["fleet"]["snap_id_min"] == 3
+    assert stats["fleet"]["snap_id_max"] == 4
+    assert stats["fleet"]["rolling"] is True     # mid-deploy spread
+    text = prov.cluster_prometheus()
+    assert 'lgbm_fleet_replica_up{replica="a"} 1' in text
+    assert 'lgbm_fleet_replica_up{replica="c"} 0' in text
+    assert 'lgbm_fleet_replica_snap_id{replica="b"} 4' in text
+    assert "lgbm_fleet_live_replicas 2" in text
+    assert "lgbm_fleet_rolling 1" in text

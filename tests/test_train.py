@@ -276,3 +276,98 @@ def test_categorical_large_values_roundtrip():
     re = lgb.Booster(model_str=bst.model_to_string())
     np.testing.assert_allclose(re.predict(X[:300]), bst.predict(X[:300]),
                                rtol=1e-6, atol=1e-9)
+
+
+# ------------------------------------------------- ranking: group bagging
+def test_lambdarank_bagging_samples_whole_query_groups():
+    """Under lambdarank, bagging_fraction must sample whole QUERY groups
+    (one uniform per query broadcast through the row->group map), never
+    split a query across the in/out-of-bag boundary — pairwise gradients
+    inside a half-sampled query would compare against missing docs."""
+    X, y, group = make_ranking(num_queries=80, per_query=10)
+    b, _ = _train(X, y, {"objective": "lambdarank",
+                         "bagging_fraction": 0.5, "bagging_freq": 1,
+                         "verbosity": -1}, rounds=3, group=group)
+    assert b._row_group is not None
+    mask = np.asarray(b._bag_mask)
+    rg = np.asarray(b._row_group)
+    for g in np.unique(rg):
+        vals = mask[rg == g]
+        assert (vals == vals[0]).all(), "query %d split by bagging" % g
+    # roughly bagging_fraction of the GROUPS are in-bag
+    picked = np.mean([mask[rg == g][0] for g in np.unique(rg)])
+    assert 0.3 < picked < 0.7
+    # non-ranking objectives keep the plain per-row path
+    Xb, yb = make_binary(n=500)
+    bb, _ = _train(Xb, yb, {"objective": "binary", "bagging_fraction": 0.5,
+                            "bagging_freq": 1, "verbosity": -1}, rounds=2)
+    assert bb._row_group is None
+
+
+def test_lambdarank_group_bagging_parity():
+    """Group-wise bagging still learns: NDCG with bagging stays close to
+    the full-data run (the satellite's parity bar)."""
+    X, y, group = make_ranking()
+    params = {"objective": "lambdarank", "metric": "ndcg", "eval_at": [5],
+              "verbosity": -1}
+    full, _ = _train(X, y, dict(params), rounds=30, group=group)
+    bagged, _ = _train(X, y, dict(params, bagging_fraction=0.7,
+                                  bagging_freq=1), rounds=30, group=group)
+    ndcg_full = dict((m, v) for _, m, v, _ in full.get_eval_at(0))["ndcg@5"]
+    ndcg_bag = dict((m, v) for _, m, v, _ in bagged.get_eval_at(0))["ndcg@5"]
+    assert ndcg_bag > 0.78
+    assert ndcg_bag > ndcg_full - 0.08
+
+
+# ------------------------------------------------- ranking: query weights
+def test_metadata_query_weights_are_doc_means():
+    """metadata.cpp LoadQueryWeights: a query's weight is the MEAN of its
+    documents' weights, lazily derived and reset on weight/query swaps."""
+    from lightgbm_tpu.io.dataset import Metadata
+    md = Metadata(6)
+    md.set_label(np.zeros(6))
+    md.set_query(np.array([2, 4]))
+    assert md.query_weights is None          # no weights: unweighted
+    md.set_weight(np.array([1.0, 3.0, 2.0, 2.0, 2.0, 2.0]))
+    np.testing.assert_allclose(md.query_weights, [2.0, 2.0])
+    md.set_weight(np.array([4.0, 4.0, 1.0, 1.0, 1.0, 1.0]))
+    np.testing.assert_allclose(md.query_weights, [4.0, 1.0])
+
+
+def test_ranking_metrics_honor_query_weights():
+    """rank_metric.hpp query_weights_ accumulation: each query's metric
+    contribution is scaled by its weight over the weight sum."""
+    from lightgbm_tpu.io.dataset import Metadata
+    X, y, group = make_ranking(num_queries=6, per_query=8)
+    n = len(y)
+    score = np.random.RandomState(0).randn(n)
+    cfg = Config({"objective": "lambdarank", "eval_at": [3]})
+    for name in ("ndcg", "map"):
+        md = Metadata(n)
+        md.set_label(y)
+        md.set_query(group)
+        plain = create_metric(name, cfg)
+        plain.init(md, n)
+        base = plain.eval(score)
+        pq = [np.asarray(plain.per_query(y[lo:lo + 8], score[lo:lo + 8]))
+              for lo in range(0, n, 8)]
+        # docs of query 0 weigh 3x -> query weights [3, 1, 1, 1, 1, 1]
+        w = np.ones(n)
+        w[:8] = 3.0
+        mdw = Metadata(n)
+        mdw.set_label(y)
+        mdw.set_query(group)
+        mdw.set_weight(w)
+        weighted = create_metric(name, cfg)
+        weighted.init(mdw, n)
+        expected = (3.0 * pq[0] + sum(pq[1:])) / 8.0
+        np.testing.assert_allclose(weighted.eval(score), expected,
+                                   rtol=1e-12)
+        # uniform weights reproduce the unweighted metric exactly
+        mdu = Metadata(n)
+        mdu.set_label(y)
+        mdu.set_query(group)
+        mdu.set_weight(np.full(n, 2.0))
+        uniform = create_metric(name, cfg)
+        uniform.init(mdu, n)
+        np.testing.assert_allclose(uniform.eval(score), base, rtol=1e-12)

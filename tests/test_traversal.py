@@ -301,3 +301,19 @@ def test_watcher_prewarms_through_engine(tmp_path):
     eng.predict("m", Xq)
     assert eng.metrics.cache_misses_after_warmup() == 0
     assert eng.metrics.recompiles_after_warmup() == rec_floor
+
+
+def test_chain_tree_with_root_left_leaf_gets_full_depth():
+    """Sparse-trained trees often come out chain-shaped with the root's
+    LEFT child a leaf and the whole spine hanging off the right child;
+    depth must count the spine, not early-out as a stump (the traversal
+    freezes mid-tree and serves a wrapped leaf index otherwise)."""
+    from lightgbm_tpu.serving.traversal import _tree_depth
+
+    # root: left -> leaf 0, right -> node 1 -> ... -> node 3 spine
+    left = np.array([-1, -2, -3, -4], np.int32)
+    right = np.array([1, 2, 3, -5], np.int32)
+    assert _tree_depth(left, right) == 4
+    # true stump: one node, both children leaves
+    assert _tree_depth(np.array([-1], np.int32),
+                       np.array([-2], np.int32)) == 1
