@@ -20,6 +20,9 @@ Entries audited:
                          fingerprint PR 6 pinned as a string compare)
 - ``grower_sharded``     the 8-virtual-device shard_map grower (the
                          psum schedule PR 5 pinned by hand)
+- ``grower_streamed_*``  one full wave of the mesh-mode streamed grower
+                         (chunks x chips: the psum'd continue flag +
+                         the learner schedule, zero extra f32 payload)
 - ``predict_b<bucket>``  every serving bucket's forward pass (the SoA
                          traversal — serving/traversal.py)
 - ``predict_cascade_b<min_bucket>``  the early-exit cascade variant
@@ -140,6 +143,22 @@ def collect_audit(workload: Optional[Dict[str, Any]] = None
             param_overrides=overrides, num_features=16)
         if sharded is not None:
             sfn, sargs, _ = sharded
+            entries[nm] = jaxpr_audit.audit_jaxpr(
+                jax.make_jaxpr(sfn)(*sargs))
+
+    # ---- streamed mesh grower, one full wave (chunks x chips,
+    # stream/grow_stream.py): the host-dispatched wave_begin ->
+    # chunk_wave -> fused chunk_wave_commit sequence under the same
+    # 8-device mesh. Pins that distributed out-of-core training adds
+    # exactly ONE collective over the in-memory learner schedule — the
+    # int32 psum'd continue flag — and zero f32 payload.
+    for nm, overrides in (("grower_streamed_data", {"frontier_rs": True}),
+                          ("grower_streamed_voting",
+                           {"voting_top_k": 2})):
+        streamed = jaxpr_audit.streamed_sharded_fn(
+            param_overrides=overrides, num_features=16)
+        if streamed is not None:
+            sfn, sargs, _ = streamed
             entries[nm] = jaxpr_audit.audit_jaxpr(
                 jax.make_jaxpr(sfn)(*sargs))
 

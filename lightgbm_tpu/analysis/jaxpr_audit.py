@@ -224,6 +224,88 @@ def sharded_frontier_fn(num_devices: int = 8,
     return fn, (xb, g, ones, ones), params
 
 
+def streamed_sharded_fn(num_devices: int = 8,
+                        param_overrides: Optional[Dict[str, Any]] = None,
+                        num_features: int = 16):
+    """The chunks-x-chips entry: ``(fn, args, params)`` such that
+    ``jax.make_jaxpr(fn)(*args)`` traces ONE full growth wave of the
+    mesh-mode StreamFrontierGrower — the host-dispatched sequence
+    ``wave_begin`` (psum'd continue flag) -> ``chunk_wave`` (no
+    collectives) -> ``chunk_wave_commit`` (the learner schedule fused
+    into the last chunk).  Its collective count/payload is the per-wave
+    comm contract of distributed out-of-core training that
+    obs/perfgate.py gates and the audit baseline records: one int32
+    psum (the flag) plus exactly the in-memory learner's schedule, so
+    the f32 payload must EQUAL the ``wave_payload_f32_*`` pins.
+
+    ``param_overrides`` picks the learner (``frontier_rs`` /
+    ``voting_top_k``), as with ``sharded_frontier_fn``.  Args are
+    ``ShapeDtypeStruct`` mirrors — tracing only, nothing executes.
+    Returns None when fewer than ``num_devices`` devices exist."""
+    import jax
+    if len(jax.devices()) < num_devices:
+        return None
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..core.grow import GrowParams
+    from ..core.split import FeatureMeta, SplitParams
+    from ..parallel.mesh import DATA_AXIS
+    from ..stream.grow_stream import StreamFrontierGrower
+    from ..stream.pipeline import ShardedChunkPipeline
+
+    r = np.random.RandomState(0)
+    world, chunk_rows, f, b = int(num_devices), 32, int(num_features), 16
+    rows = 2 * chunk_rows                   # 2 uniform chunks per shard
+    shard_chunks = [[r.randint(0, b, (rows, f)).astype(np.uint8)]
+                    for _ in range(world)]
+    mesh = Mesh(np.asarray(jax.devices()[:world]), (DATA_AXIS,))
+    pipe = ShardedChunkPipeline(shard_chunks, [rows] * world, chunk_rows,
+                                mesh)
+    meta = FeatureMeta(
+        num_bin=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        penalty=jnp.ones((f,), jnp.float32),
+        monotone=jnp.zeros((f,), jnp.int32))
+    sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, max_delta_step=0.0,
+                     min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
+                     min_gain_to_split=0.0, max_cat_threshold=32,
+                     cat_smooth=10.0, cat_l2=10.0, max_cat_to_onehot=4,
+                     min_data_per_group=100)
+    params = GrowParams(num_leaves=7, num_bins=b, max_depth=3, split=sp,
+                        row_chunk=16384, hist_impl="scatter",
+                        **(param_overrides or {}))
+    grower = StreamFrontierGrower(pipe, meta, params, mesh=mesh)
+    fns = grower._audit_fns
+
+    n = pipe.num_padded
+    sds = jax.ShapeDtypeStruct
+    scal = sds((), jnp.float32)
+    fmask = sds((f,), jnp.bool_)
+    acc0 = sds((world,) + grower._hist_shape, jnp.float32)
+    state = jax.eval_shape(fns["root_commit"], acc0, scal, scal, scal,
+                           fmask)
+    xb_c = sds((world * chunk_rows, pipe.num_cols), jnp.uint8)
+    row = sds((n,), jnp.float32)
+    hist_acc = sds((world, grower.wave_width) + grower._hist_shape,
+                   jnp.float32)
+
+    def one_wave(state, xb_c, grad, hess, mask, hist_acc, fmask):
+        do, plan = fns["wave_begin"](state.best, state.tree.num_leaves)
+        leaf_id, hist_acc = fns["chunk_wave"](
+            xb_c, np.int32(0), state.leaf_id, grad, hess, mask, plan,
+            hist_acc)
+        state = fns["chunk_wave_commit"](
+            xb_c, np.int32(chunk_rows), state, leaf_id, grad, hess, mask,
+            plan, hist_acc, fmask)
+        return do, state
+
+    return one_wave, (state, xb_c, row, row, row, hist_acc, fmask), params
+
+
 def schedule_signature(schedule: List[Dict[str, Any]]) -> str:
     """Canonical string form of a collective schedule (baseline diffs)."""
     return json.dumps(schedule, sort_keys=True)

@@ -271,6 +271,9 @@ class GBDT:
         self._stream_pre = None
         self._stream_post = None
         self._stream_capture = ()
+        self._stream_layout = None
+        self._stream_perm = None
+        self._stream_col_pad = 0
         # observability facade (lightgbm_tpu.obs): replaced by the
         # config-driven one in _setup_train; loaded/predict-only boosters
         # keep the disabled no-op
@@ -281,6 +284,93 @@ class GBDT:
             self._setup_train(train_data)
 
     # ------------------------------------------------------------ setup
+    def _setup_stream_mesh(self, ds) -> np.ndarray:
+        """Chunks x chips: validate the topology, build the sharded chunk
+        pipeline, and fix the SHARD-MAJOR padded row layout (see
+        stream/pipeline.py). Returns ``row_valid`` in that layout.
+
+        Two topologies land here: a multi-process run whose dataset was
+        ingested through a ``ShardedSource`` (each process holds exactly
+        its rank's row block — ``shard_world`` must equal the data-axis
+        size), and a single-process multi-device run whose resident chunk
+        list is split into contiguous rank-ordered blocks on the spot
+        with the same shard-assignment contract.
+        """
+        cfg = self.config
+        from ..parallel import mesh as mesh_mod
+        from ..stream.pipeline import (ShardedChunkPipeline,
+                                       shard_rows_host, shard_rows_perm,
+                                       split_chunks_rows)
+        from ..stream.source import shard_offsets
+        mesh = self.mesh
+        axis = mesh_mod.DATA_AXIS
+        if axis not in mesh.axis_names:
+            raise LightGBMError(
+                "streamed mesh training is data-parallel only: mesh_shape "
+                "must map the %r axis (got axes %s)"
+                % (axis, list(mesh.axis_names)))
+        fsize = (mesh.shape[mesh_mod.FEATURE_AXIS]
+                 if mesh_mod.FEATURE_AXIS in mesh.axis_names else 1)
+        if fsize > 1:
+            raise LightGBMError(
+                "streamed training cannot shard the feature axis (the "
+                "chunk stream is row-partitioned); use a pure data mesh "
+                "(tree_learner=data|voting) or set "
+                "data_stream_chunk_rows=0")
+        if int(cfg.data_stream_chunk_rows) <= 0:
+            raise LightGBMError(
+                "streamed mesh training needs an explicit "
+                "data_stream_chunk_rows: the per-wave kernel shapes must "
+                "agree on every process")
+        dsize = int(mesh.shape[axis])
+        # reduce-scatter wave histograms need the stored columns to tile
+        # over the data axis (DataRSLearner); pad columns here and the
+        # feature metadata below with unusable num_bin=1 entries
+        self._frontier_rs = (
+            cfg.tree_learner == "data"
+            and bool(cfg.tpu_frontier_rs)
+            and _hist_dtype(cfg) != "f64")
+        ncols = int(ds.chunks[0].shape[1]) if ds.chunks \
+            else int(ds.num_columns)
+        col_pad = (-ncols) % dsize if self._frontier_rs else 0
+        self._stream_col_pad = col_pad
+        world = int(getattr(ds, "shard_world", 1) or 1)
+        if world > 1:
+            if world != dsize:
+                raise LightGBMError(
+                    "dataset is sharded %d ways but the mesh data axis "
+                    "has %d positions; ShardedSource world must equal "
+                    "the data-axis size" % (world, dsize))
+            counts = [int(c) for c in ds.shard_row_counts]
+            shard_chunks = [ds.chunks]
+        else:
+            if jax.process_count() > 1:
+                raise LightGBMError(
+                    "multi-process streamed training needs a sharded "
+                    "ingest: wrap the source in stream.source."
+                    "ShardedSource(rank, world) so each process streams "
+                    "only its row block")
+            offs = shard_offsets(ds.num_data, dsize)
+            counts = [offs[p + 1] - offs[p] for p in range(dsize)]
+            shard_chunks = split_chunks_rows(ds.chunks, offs)
+        self._stream = ShardedChunkPipeline(
+            shard_chunks, counts, int(cfg.data_stream_chunk_rows), mesh,
+            prefetch=int(cfg.data_stream_prefetch), col_pad=col_pad)
+        if world > 1 and \
+                self._stream.local_shards != [int(ds.shard_rank)]:
+            raise LightGBMError(
+                "shard/mesh misalignment: this process ingested shard %d "
+                "but addresses mesh position(s) %s — keep process rank "
+                "order equal to shard rank order"
+                % (int(ds.shard_rank), self._stream.local_shards))
+        offs = self._stream.shard_offsets()
+        local_padded = self._stream.local_padded
+        self._stream_layout = (
+            lambda a, _o=offs, _n=local_padded: shard_rows_host(a, _o, _n))
+        self._stream_perm = shard_rows_perm(offs, local_padded)
+        return shard_rows_host(np.ones(ds.num_data, np.float32), offs,
+                               local_padded)
+
     def _setup_train(self, ds: BinnedDataset) -> None:
         cfg = self.config
         from ..parallel import mesh as mesh_mod
@@ -289,43 +379,57 @@ class GBDT:
         xb_np = ds.X_binned
         row_valid = None
         streamed = bool(getattr(ds, "is_streamed", False))
+        self._stream_layout = None   # host [n0,...] -> padded-layout rows
+        self._stream_perm = None     # padded index of each original row
+        self._stream_col_pad = 0
         if streamed:
             # out-of-core path: the bin matrix exists only as host chunks;
             # everything per-row stays device-resident at padded length
-            if self.mesh is not None:
-                raise LightGBMError(
-                    "streamed training is single-device; unset mesh_shape "
-                    "(chunks x devices is tracked in ROADMAP.md)")
             if cfg.tree_growth != "frontier":
                 raise LightGBMError(
                     "streamed training requires tree_growth=frontier")
             if _hist_dtype(cfg) == "f64":
+                # the satellite gate for streamed mesh + f64 is this same
+                # branch: every streamed run accumulates f32 wave
+                # histograms (config.py pre-validates the mesh spelling)
                 raise LightGBMError(
                     "streamed training accumulates f32 wave histograms; "
-                    "set gpu_use_dp=false")
-            from ..core.binpack import resolve_bin_packing
-            from ..stream.pipeline import ChunkPipeline
-            chunk_cap = int(cfg.data_stream_chunk_rows) or \
-                max(1, max(ds.chunk_row_counts))
-            # packed host chunks (core/binpack.py): word-pack at repack
-            # time so every host->device transfer ships the kernel-native
-            # int32-word layout; under tpu_bin_packing=nibble the DATASET
-            # pair coding already halved the stored columns, so the
-            # per-row transfer bytes halve with it
-            stream_packed = resolve_bin_packing(
-                cfg.tpu_bin_packing, streamed=True,
-                tpu_shaped=partition_mod.tpu_shaped_backend(),
-                col_num_bin=list(ds.col_num_bin)) != "none"
-            self._stream = ChunkPipeline(
-                ds.chunks, chunk_cap,
-                prefetch=int(cfg.data_stream_prefetch),
-                packed=stream_packed)
-            pad = self._stream.num_padded - ds.num_data
-            if pad:
-                row_valid = np.concatenate(
-                    [np.ones(ds.num_data, np.float32),
-                     np.zeros(pad, np.float32)])
-        if self.mesh is not None:
+                    "set gpu_use_dp=false" + (
+                        " (streamed + mesh_shape requires f32)"
+                        if self.mesh is not None else ""))
+            if self.mesh is not None:
+                row_valid = self._setup_stream_mesh(ds)
+            else:
+                if int(getattr(ds, "shard_world", 1) or 1) > 1:
+                    raise LightGBMError(
+                        "dataset was ingested as shard %d/%d but no mesh "
+                        "is configured; set mesh_shape=[%d] (or ingest "
+                        "without a ShardedSource)"
+                        % (ds.shard_rank, ds.shard_world, ds.shard_world))
+                from ..core.binpack import resolve_bin_packing
+                from ..stream.pipeline import ChunkPipeline
+                chunk_cap = int(cfg.data_stream_chunk_rows) or \
+                    max(1, max(ds.chunk_row_counts))
+                # packed host chunks (core/binpack.py): word-pack at repack
+                # time so every host->device transfer ships the
+                # kernel-native int32-word layout; under
+                # tpu_bin_packing=nibble the DATASET pair coding already
+                # halved the stored columns, so the per-row transfer bytes
+                # halve with it
+                stream_packed = resolve_bin_packing(
+                    cfg.tpu_bin_packing, streamed=True,
+                    tpu_shaped=partition_mod.tpu_shaped_backend(),
+                    col_num_bin=list(ds.col_num_bin)) != "none"
+                self._stream = ChunkPipeline(
+                    ds.chunks, chunk_cap,
+                    prefetch=int(cfg.data_stream_prefetch),
+                    packed=stream_packed)
+                pad = self._stream.num_padded - ds.num_data
+                if pad:
+                    row_valid = np.concatenate(
+                        [np.ones(ds.num_data, np.float32),
+                         np.zeros(pad, np.float32)])
+        if self.mesh is not None and not streamed:
             # pad rows to a multiple of the data-axis size so every shard is
             # even; padded rows carry mask 0 everywhere (the distributed
             # loader's row partition, dataset_loader.cpp:469-495, without the
@@ -369,7 +473,7 @@ class GBDT:
                 "enable_nbit_packing=false for distributed training")
         self.num_data = (self._stream.num_padded if streamed
                          else xb_np.shape[0])
-        self._feature_pad = (0 if streamed
+        self._feature_pad = (self._stream_col_pad if streamed
                              else xb_np.shape[1] - ds.num_columns)
         self._row_valid = (jnp.asarray(row_valid) if row_valid is not None
                            else None)
@@ -398,13 +502,16 @@ class GBDT:
             # like the reference's feature-parallel machines); each device
             # additionally gets its own column slice for histogram work
             self._fp_capture = self._setup_feature_parallel(xb_np)
-        elif self.mesh is not None:
+        elif self.mesh is not None and self.xb is not None:
             self.xb = jax.device_put(
                 self.xb, mesh_mod.feature_sharding(self.mesh))
         if self.objective is not None:
             self.objective.init(ds.metadata, ds.num_data)
             if self.mesh is not None:
-                self.objective.pad_to(self.num_data, self.mesh)
+                # streamed mesh: per-row arrays go to the shard-major
+                # padded layout instead of trailing-padding
+                self.objective.pad_to(self.num_data, self.mesh,
+                                      layout=self._stream_layout)
             elif streamed and self.num_data > ds.num_data:
                 # chunk-uniform padding: per-row objective arrays stretch
                 # to the padded length; padded rows are masked everywhere
@@ -619,11 +726,14 @@ class GBDT:
             # the aux slot off, so it stays off there (iteration-level
             # grad/hess health still applies on every path)
             obs_health=(frontier_mode and not self._partition_on_mesh
+                        and not (streamed and self.mesh is not None)
                         and self.obs.health_enabled),
             # model statistics ride the same aux slot under the same
             # guard; the shard_map learners slice aux off, so they fall
-            # back to host-side recomputation at materialize
+            # back to host-side recomputation at materialize (the
+            # streamed mesh grower carries no aux slot at all)
             obs_modelstats=(frontier_mode and not self._partition_on_mesh
+                            and not (streamed and self.mesh is not None)
                             and bool(cfg.obs_modelstats)))
 
         self._word_packed_cols = word_packed_cols
@@ -647,7 +757,8 @@ class GBDT:
                     "(tree_growth=frontier with f32 histograms)")
             from ..stream.grow_stream import StreamFrontierGrower
             self._stream_grower = StreamFrontierGrower(
-                self._stream, self.feature_meta, self.grow_params)
+                self._stream, self.feature_meta, self.grow_params,
+                mesh=self.mesh)
 
         k = self.num_tree_per_iteration
         n = self.num_data
@@ -657,9 +768,14 @@ class GBDT:
         if ds.metadata.init_score is not None:
             isc = np.asarray(ds.metadata.init_score, np.float32).reshape(-1)
             if len(isc) == n0 * k:
-                init_scores[:n0] = isc.reshape(k, n0).T
+                vals = isc.reshape(k, n0).T
             else:
-                init_scores[:n0] = np.tile(isc.reshape(-1, 1), (1, k))
+                vals = np.tile(isc.reshape(-1, 1), (1, k))
+            if self._stream_layout is not None:
+                init_scores = self._stream_layout(
+                    np.asarray(vals, np.float32))
+            else:
+                init_scores[:n0] = vals
         self._init_scores_provided = ds.metadata.init_score is not None
         self.scores = jnp.asarray(init_scores)
         if self.mesh is not None:
@@ -1849,6 +1965,45 @@ class GBDT:
         self._models = list(value)
 
     # ------------------------------------------------- checkpoint state
+    def _capture_rows(self, arr) -> np.ndarray:
+        """Host copy of a per-row device array for checkpointing. Under a
+        multi-process mesh the array is row-sharded and NOT fully
+        addressable; each process captures its OWN rows (sorted shard
+        order), and ``_restore_rows`` rebuilds the global array from that
+        local block — per-rank snapshots stay rank-local, matching the
+        rank-folded dataset fingerprint that guards shard reassignment."""
+        arr = jnp.asarray(arr)
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    def _restore_rows(self, host, extra_dims: int = 0):
+        """Inverse of ``_capture_rows``: device array in the training
+        row layout from a host capture (global when fully addressable,
+        this process's rows otherwise)."""
+        host = np.asarray(host)
+        if self.mesh is None:
+            return jnp.asarray(host)
+        from ..parallel import mesh as mesh_mod
+        sh = mesh_mod.row_sharding(self.mesh, extra_dims=extra_dims)
+        if host.shape[0] == self.num_data:
+            return jax.device_put(host, sh)
+        pid = jax.process_index()
+        devices = list(np.asarray(self.mesh.devices).reshape(-1))
+        local = [d for d in devices if d.process_index == pid]
+        if not local or host.shape[0] % len(local):
+            raise LightGBMError(
+                "checkpointed row block of %d rows does not tile over %d "
+                "local mesh devices — was the snapshot written under a "
+                "different mesh?" % (host.shape[0], len(local)))
+        blk = host.shape[0] // len(local)
+        bufs = [jax.device_put(host[i * blk:(i + 1) * blk], d)
+                for i, d in enumerate(local)]
+        return jax.make_array_from_single_device_arrays(
+            (self.num_data,) + host.shape[1:], sh, bufs)
+
     def training_state(self):
         """Complete mutable training state as ``(meta, arrays)`` — the
         checkpoint subsystem's capture point (lightgbm_tpu.checkpoint).
@@ -1870,9 +2025,9 @@ class GBDT:
             "boost_from_average_done": bool(self.boost_from_average_done),
         }
         arrays: Dict[str, np.ndarray] = {
-            "scores": np.asarray(self.scores),
+            "scores": self._capture_rows(self.scores),
             "bag_key": np.asarray(self._bag_key),
-            "bag_mask": np.asarray(self._bag_mask),
+            "bag_mask": self._capture_rows(self._bag_mask),
             "stopped_dev": np.asarray(self._stopped_dev),
         }
         ff_meta, ff_keys = snap_mod.rng_state_split(self._rng)
@@ -1917,14 +2072,11 @@ class GBDT:
         self._stopped_dev = (jnp.asarray(bool(arrays["stopped_dev"]))
                              if "stopped_dev" in arrays
                              else jnp.asarray(self._stopped))
-        scores = jnp.asarray(np.asarray(arrays["scores"], np.float32))
-        if self.mesh is not None:
-            from ..parallel import mesh as mesh_mod
-            scores = jax.device_put(
-                scores, mesh_mod.row_sharding(self.mesh, extra_dims=1))
-        self.scores = scores
+        self.scores = self._restore_rows(
+            np.asarray(arrays["scores"], np.float32), extra_dims=1)
         self._bag_key = jnp.asarray(arrays["bag_key"], dtype=jnp.uint32)
-        self._bag_mask = jnp.asarray(arrays["bag_mask"], dtype=jnp.float32)
+        self._bag_mask = self._restore_rows(
+            np.asarray(arrays["bag_mask"], np.float32))
         self._rng.set_state(snap_mod.rng_state_join(meta["ff_rng"],
                                                     arrays["ff_rng_keys"]))
         if "init_score_offsets" in arrays:
@@ -2312,7 +2464,19 @@ class GBDT:
         conv = (self.objective.convert_output if self.objective is not None
                 else None)
         if data_idx == 0:
-            scores = np.asarray(self.scores)[:self.num_data_orig]
+            if self._stream_perm is not None:
+                # streamed mesh: scores live in the shard-major padded
+                # layout; gather original-row order back (train-set eval
+                # under a MULTI-process mesh is not supported — the
+                # global scores are not host-addressable from one rank)
+                if not getattr(self.scores, "is_fully_addressable", True):
+                    raise LightGBMError(
+                        "train-set metrics are not available under "
+                        "multi-process streamed training; evaluate on a "
+                        "valid set or predict() from the saved model")
+                scores = np.asarray(self.scores)[self._stream_perm]
+            else:
+                scores = np.asarray(self.scores)[:self.num_data_orig]
             for m in self.train_metrics:
                 vals = m.eval(scores if self.num_tree_per_iteration > 1
                               else scores[:, 0], conv)

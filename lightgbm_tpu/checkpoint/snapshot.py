@@ -92,6 +92,21 @@ def dataset_fingerprint(binned) -> str:
         h.update(repr((binned.num_data,
                        binned.chunks[0].shape[1] if binned.chunks
                        else 0)).encode())
+        comm = getattr(binned, "shard_comm", None)
+        if comm is not None:
+            # sharded stream: fold the RANK-ORDERED (rank, local digest,
+            # local rows) tuples into one fingerprint shared by every
+            # rank. Resume then refuses a reshuffled shard assignment —
+            # the same rows dealt to different ranks change the tuple
+            # order and thus the digest — while the identical layout
+            # reproduces it exactly. COLLECTIVE: lockstep on all ranks.
+            local = h.hexdigest()
+            gathered = comm.allgather(
+                (int(binned.shard_rank), local,
+                 int(binned.shard_num_data)))
+            h = hashlib.sha256()
+            for rank, dig, nrows in sorted(gathered):
+                h.update(repr((int(rank), str(dig), int(nrows))).encode())
     label = getattr(binned.metadata, "label", None)
     if label is not None:
         h.update(np.ascontiguousarray(np.asarray(label)).tobytes())

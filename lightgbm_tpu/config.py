@@ -612,11 +612,23 @@ class Config:
                     "data_stream_chunk_rows supports boosting gbdt/goss "
                     "only (dart/rf replay full binned data per iteration); "
                     "got boosting=%s" % self.boosting)
-            if self.mesh_shape:
+            # chunks x chips: a data-parallel mesh composes with the
+            # chunk stream (each process sweeps its row shard and the
+            # learner collectives fire once per wave); the remaining
+            # unsupported combinations each fail here BY NAME
+            if self.mesh_shape and self.tree_learner == "feature":
                 raise LightGBMError(
-                    "data_stream_chunk_rows does not compose with a device "
-                    "mesh yet (chunks x devices is tracked in ROADMAP.md); "
-                    "clear mesh_shape or data_stream_chunk_rows")
+                    "gate streamed+feature-learner: the chunk stream is "
+                    "row-partitioned, so tree_learner=feature (column-"
+                    "partitioned search) cannot ride it; use "
+                    "tree_learner=data or voting with "
+                    "data_stream_chunk_rows")
+            if self.mesh_shape and self.gpu_use_dp:
+                raise LightGBMError(
+                    "gate streamed-mesh+f64: streamed mesh training "
+                    "accumulates f32 wave histograms and the reduce-"
+                    "scatter/voting schedules bitcast f32 records; unset "
+                    "gpu_use_dp or data_stream_chunk_rows/mesh_shape")
             if self.gpu_use_dp:
                 raise LightGBMError(
                     "data_stream_chunk_rows accumulates f32 wave "

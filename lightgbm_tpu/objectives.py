@@ -55,12 +55,17 @@ class ObjectiveFunction:
             return grad * self.weights, hess * self.weights
         return grad, hess
 
-    def pad_to(self, num_rows: int, mesh=None) -> None:
+    def pad_to(self, num_rows: int, mesh=None, layout=None) -> None:
         """Pad per-row arrays for even mesh sharding (padded rows are masked
         out of every histogram/sum by the driver's row_valid mask; gradients
         computed on them are never used). Every jnp attribute of length
         num_data is treated as per-row (label, weights, trans_label,
         label_weight, ...).
+
+        ``layout`` (streamed mesh training) maps a host [n0, ...] array
+        to the full padded-row layout — shard-major blocks rather than
+        trailing padding (stream/pipeline.py shard_rows_host) — before
+        the row sharding is applied.
 
         Pre-pad host copies are kept (``host()``): host-side statistics
         like boost_from_score must see neither the padding rows (they'd
@@ -77,16 +82,21 @@ class ObjectiveFunction:
             if not (isinstance(val, jnp.ndarray) and val.ndim >= 1
                     and val.shape[0] == n0):
                 continue
-            if val.ndim > 1 and sh is not None:
+            if val.ndim > 1 and sh is not None and layout is None:
                 # mesh row_sharding is rank-1; 2-D per-row arrays
                 # (multiclass onehot) keep the mesh path's 1-D contract
                 continue
             self._host_rows[name] = np.asarray(val)
-            if pad > 0:
+            if layout is not None:
+                val = jnp.asarray(layout(self._host_rows[name]))
+            elif pad > 0:
                 val = jnp.concatenate(
                     [val, jnp.zeros((pad,) + val.shape[1:], val.dtype)])
             if sh is not None:
-                val = jax.device_put(val, sh)
+                from .parallel.mesh import row_sharding as _rs
+                val = jax.device_put(
+                    val, _rs(mesh, extra_dims=val.ndim - 1)
+                    if val.ndim > 1 else sh)
             setattr(self, name, val)
 
     def host(self, name: str):

@@ -151,7 +151,12 @@ class DataProfile:
         same per-feature decode (bundle offset, joint-pack unpack, clamp
         to default_bin) per chunk yields bit-identical counts to
         ``from_binned_dataset`` on the concatenated matrix — asserted in
-        tests/test_stream.py."""
+        tests/test_stream.py. Under a sharded ingest (``shard_comm`` set)
+        every rank profiles only its local chunks and the integer count
+        vectors are summed over the host allgather — integer addition is
+        associative, so the merged profile matches the single-process
+        profile bit-identically (COLLECTIVE: all ranks must call this in
+        lockstep; training_state capture does)."""
         (feat_col, feat_offset, _bundled, pack_div, pack_mod,
          _partner) = ds.feature_layout()
         nfeat = ds.num_features
@@ -168,6 +173,13 @@ class DataProfile:
                 v = v - int(feat_offset[i])
                 v = np.where((v >= 0) & (v < m.num_bin), v, m.default_bin)
                 counts[i] += np.bincount(v, minlength=m.num_bin)
+        comm = getattr(ds, "shard_comm", None)
+        if comm is not None:
+            gathered = comm.allgather(
+                [np.asarray(c, np.int64) for c in counts])
+            counts = [np.sum([np.asarray(g[i], np.int64)
+                              for g in gathered], axis=0)
+                      for i in range(nfeat)]
         feats: List[Dict] = []
         for i in range(nfeat):
             j = ds.real_feature_index(i)

@@ -259,6 +259,7 @@ def measure(workload: Optional[Dict[str, Any]] = None
             counters["wave_collectives_" + suffix] = wave[0]
             counters["wave_payload_f32_" + suffix] = wave[1]
     counters.update(_stream_counters(wl))
+    counters.update(_stream_dist_counters(wl))
     counters.update(_packing_counters())
     counters.update(_refit_counters(bst, wl))
     return counters, wl
@@ -404,6 +405,104 @@ def _stream_counters(wl: Dict[str, Any]) -> Dict[str, Any]:
     g4 = b4._stream_grower
     counters["stream_dispatch_overhead_per_wave"] = round(
         g4.wave_dispatches / max(g4.waves, 1) - b4._stream.num_chunks, 6)
+    return counters
+
+
+def _stream_dist_counters(wl: Dict[str, Any]) -> Dict[str, Any]:
+    """Chunks-x-chips counters (mesh-mode StreamFrontierGrower,
+    stream/grow_stream.py): the comm and compile contracts of
+    DISTRIBUTED out-of-core training, measured on a single-process mesh
+    so the gate needs no multi-process launch (tools/dist_train_smoke.py
+    covers the real 2-process run).
+
+    - ``stream_dist_wave_collectives_{data,voting}``: collective ops in
+      ONE traced growth wave (jaxpr_audit.streamed_sharded_fn) — exactly
+      one int32 psum (the replicated continue flag that replaced the
+      host bool sync) plus the in-memory learner's schedule, so data_rs
+      reads 3 and voting 4;
+    - ``stream_dist_wave_payload_f32_{data,voting}``: f32 elements
+      received per device per wave — the flag is int32, so these must
+      EQUAL the in-memory ``wave_payload_f32_*`` pins (streaming adds
+      zero collective payload per wave, the PR's headline contract);
+    - ``stream_dist_compile_chunk_invariance``: same workload trained
+      under a 2-shard mesh at 1 vs 2 chunks per shard builds the same
+      number of programs (difference exactly 0);
+    - ``stream_dist_compiles_after_warmup``: further streamed mesh
+      iterations on a warm booster compile NOTHING (exact 0)."""
+    import numpy as np
+
+    import jax
+
+    import lightgbm_tpu as lgb
+    from ..analysis import jaxpr_audit
+    from ..profiling import backend_compile_count, install_compile_hook
+
+    counters: Dict[str, Any] = {}
+    num_devices = 8
+    for suffix, ov in (("data", {"frontier_rs": True}),
+                       ("voting", {"voting_top_k": 2})):
+        entry = jaxpr_audit.streamed_sharded_fn(num_devices=num_devices,
+                                                param_overrides=ov)
+        if entry is None:
+            continue
+        fn, args, _ = entry
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        ops = 0
+        payload = 0.0
+        # one_wave IS one wave (no outer loop), so the whole program's
+        # schedule is the per-wave schedule; payload rules mirror
+        # _wave_collectives (elements RECEIVED per device, f32 only)
+        for e in jaxpr_audit.iter_eqns(jaxpr):
+            if e.primitive.name not in jaxpr_audit.COLLECTIVE_PRIMITIVES:
+                continue
+            ops += 1
+            aval = e.invars[0].aval
+            if str(getattr(aval, "dtype", "")) != "float32":
+                continue
+            elems = float(np.prod(aval.shape)) if aval.shape else 1.0
+            if e.primitive.name in ("reduce_scatter", "psum_scatter"):
+                payload += elems / num_devices
+            elif e.primitive.name == "all_gather":
+                payload += elems * num_devices
+            else:
+                payload += elems
+        counters["stream_dist_wave_collectives_" + suffix] = float(ops)
+        counters["stream_dist_wave_payload_f32_" + suffix] = payload
+
+    if len(jax.devices()) < 2:
+        return counters
+    install_compile_hook()
+    rows = int(wl["rows"])
+    rng = np.random.RandomState(int(wl["seed"]))
+    X = rng.randn(rows, int(wl["features"])).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+
+    def run(chunks_per_shard: int):
+        params = {"objective": "binary", "verbosity": -1,
+                  "num_leaves": int(wl["num_leaves"]),
+                  "max_depth": int(wl["max_depth"]),
+                  "tree_growth": "frontier", "observability": "none",
+                  "seed": int(wl["seed"]), "tree_learner": "data",
+                  "mesh_shape": [2], "num_machines": 2,
+                  "data_stream_chunk_rows": rows // (2 * chunks_per_shard)}
+        c0 = backend_compile_count()
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=int(wl["iters"]))
+        _ = bst._impl.models                 # force the flush
+        return bst._impl, float(backend_compile_count() - c0)
+
+    # throwaway 1-chunk warm run absorbs every once-per-process compile;
+    # the two measured runs then see only their own per-chunk-shape
+    # program sets, whose cardinality must match (as _stream_counters)
+    run(1)
+    _, compiles2 = run(2)
+    b4, compiles4 = run(4)
+    counters["stream_dist_compile_chunk_invariance"] = \
+        compiles4 - compiles2
+    c0 = backend_compile_count()
+    b4.train_many(int(wl["iters"]))
+    counters["stream_dist_compiles_after_warmup"] = \
+        float(backend_compile_count() - c0)
     return counters
 
 

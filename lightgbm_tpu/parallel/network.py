@@ -299,17 +299,31 @@ def check_model_agreement(digest: str, comm: Optional["HostComm"] = None,
     return digests
 
 
+# one KV comm per namespace, process-wide: the round counter lives on
+# the instance, so handing out a FRESH KvHostComm for a namespace that
+# already ran an allgather would reuse round-0 keys and fail with
+# ALREADY_EXISTS. Every process acquires namespaces in lockstep (the
+# callers are collective), so the cached counters stay aligned.
+_KV_COMMS: dict = {}
+
+
 def default_host_comm(namespace: str = "lgbm_hostcomm",
                       timeout_ms: int = 60000) -> Optional[HostComm]:
     """The right host-metadata allgather for the current topology: None
     single-process, the coordination-service KV comm on the CPU backend
     (which cannot run multiprocess computations), ``process_allgather``
-    everywhere else (TPU/GPU meshes)."""
+    everywhere else (TPU/GPU meshes). KV comms are cached per namespace
+    (first call's ``timeout_ms`` wins) so repeated acquisitions continue
+    one round sequence instead of colliding on reused keys."""
     import jax
     if jax.process_count() <= 1:
         return None
     if jax.default_backend() == "cpu":
-        return KvHostComm(namespace=namespace, timeout_ms=timeout_ms)
+        comm = _KV_COMMS.get(namespace)
+        if comm is None:
+            comm = KvHostComm(namespace=namespace, timeout_ms=timeout_ms)
+            _KV_COMMS[namespace] = comm
+        return comm
     return JaxHostComm()
 
 

@@ -41,10 +41,26 @@ class StreamedDataset(BinnedDataset):
     def __init__(self):
         super().__init__()
         self.chunks: List[np.ndarray] = []
+        # chunks x chips (sharded ingest): this process holds only its
+        # rank's contiguous row block; num_data / metadata stay GLOBAL.
+        # shard_row_counts lists every rank's row count in rank order and
+        # shard_comm is the host allgather used for the cross-rank drift
+        # profile and the checkpoint fingerprint (both collective calls).
+        self.shard_rank = 0
+        self.shard_world = 1
+        self.shard_row_counts: Optional[List[int]] = None
+        self.shard_comm = None
 
     @property
     def chunk_row_counts(self) -> List[int]:
         return [int(c.shape[0]) for c in self.chunks]
+
+    @property
+    def shard_num_data(self) -> int:
+        """Rows resident on THIS rank (== num_data when unsharded)."""
+        if self.shard_row_counts is not None:
+            return int(self.shard_row_counts[self.shard_rank])
+        return int(self.num_data)
 
     def data_profile(self):
         """Per-feature bin-occupancy profile accumulated chunk-by-chunk
@@ -82,14 +98,43 @@ def _systematic_sample(stride: int):
 def ingest(source: ChunkSource, config,
            feature_names: Optional[List[str]] = None,
            categorical_feature=None,
-           sample_stride: int = 0) -> StreamedDataset:
+           sample_stride: int = 0,
+           comm=None) -> StreamedDataset:
     """Build a ``StreamedDataset`` from a chunk source (two passes).
 
     ``sample_stride > 0`` switches round 1 from reservoir sampling to
     systematic every-k-th-row sampling (capped at
     ``bin_construct_sample_cnt`` rows, earliest kept).
+
+    ``comm`` (a ``parallel.network.HostComm``) switches on SHARDED ingest
+    for a ``stream.source.ShardedSource``: every rank streams only its
+    contiguous row block, then one host allgather merges the per-rank
+    reservoir samples (rank order == original row order, so with
+    ``bin_construct_sample_cnt >= n_global`` the merged sample IS the
+    full data in order and bin boundaries are bit-identical to the
+    serial / in-memory loaders; an over-cap merge is subsampled with a
+    deterministic seed, identical on every rank but not serial-identical)
+    and the per-rank labels into a GLOBAL label vector. The returned
+    dataset keeps only the local chunks but reports global ``num_data``,
+    global metadata, and the shard layout (``shard_rank`` /
+    ``shard_world`` / ``shard_row_counts``).
     """
     sample_cnt = int(config.bin_construct_sample_cnt)
+    shard_world = int(getattr(source, "shard_world", 1) or 1)
+    if comm is None and shard_world > 1:
+        from ..parallel import network
+        comm = network.default_host_comm(namespace="lgbm_stream_ingest")
+        if comm is None:
+            raise LightGBMError(
+                "sharded streamed ingest (ShardedSource with world=%d) "
+                "needs a host allgather: initialize jax.distributed "
+                "(parallel.network.init) or pass comm= explicitly"
+                % shard_world)
+    if comm is not None and shard_world <= 1:
+        raise LightGBMError(
+            "sharded streamed ingest needs a sharded source "
+            "(stream.source.ShardedSource) carrying shard_rank/"
+            "shard_world; got an unsharded %s" % type(source).__name__)
     rng = np.random.RandomState(config.data_random_seed)
     picker = _systematic_sample(int(sample_stride)) if sample_stride > 0 \
         else None
@@ -141,9 +186,53 @@ def ingest(source: ChunkSource, config,
     if n_total == 0:
         raise LightGBMError("streamed source yielded no rows")
 
+    n_local = n_total
+    shard_rank = 0
+    shard_row_counts: Optional[List[int]] = None
+    global_label: Optional[np.ndarray] = None
+    sample_mat = np.asarray(sample_rows)
+    if comm is not None:
+        shard_rank = int(getattr(source, "shard_rank", 0))
+        local_label = np.concatenate(labels) if labels else None
+        gathered = comm.allgather({
+            "rank": shard_rank, "world": shard_world, "n": int(n_local),
+            "nfeat": int(n_features), "sample": sample_mat,
+            "label": local_label})
+        if len(gathered) != shard_world or any(
+                g["rank"] != i or g["world"] != shard_world
+                for i, g in enumerate(gathered)):
+            raise LightGBMError(
+                "sharded ingest rank/world mismatch: expected ranks 0..%d, "
+                "got %s" % (shard_world - 1,
+                            [(g["rank"], g["world"]) for g in gathered]))
+        if len({g["nfeat"] for g in gathered}) != 1:
+            raise LightGBMError(
+                "sharded ingest feature-count mismatch across ranks: %s"
+                % [g["nfeat"] for g in gathered])
+        shard_row_counts = [int(g["n"]) for g in gathered]
+        has_label = [g["label"] is not None for g in gathered]
+        if any(has_label) and not all(has_label):
+            raise LightGBMError(
+                "sharded ingest: some ranks carry labels and some do not")
+        if all(has_label):
+            global_label = np.concatenate([g["label"] for g in gathered])
+        # rank order == original row order (shard-assignment contract in
+        # stream/source.py), so the concatenated sample reproduces what a
+        # single process would have kept whenever every rank's reservoir
+        # fill phase never overflowed
+        sample_mat = np.concatenate([
+            np.asarray(g["sample"]).reshape(-1, n_features)
+            for g in gathered])
+        if sample_mat.shape[0] > sample_cnt:
+            sub = np.random.RandomState(config.data_random_seed)
+            keep = np.sort(sub.choice(sample_mat.shape[0], sample_cnt,
+                                      replace=False))
+            sample_mat = sample_mat[keep]
+        n_total = int(sum(shard_row_counts))
+
     names = feature_names or source.feature_names
     proto = BinnedDataset.from_matrix(
-        np.asarray(sample_rows), config,
+        sample_mat, config,
         feature_names=names, categorical_feature=categorical_feature)
 
     source.reset()
@@ -154,11 +243,11 @@ def ingest(source: ChunkSource, config,
             np.asarray(Xc, np.float64), config, reference=proto)
         chunks.append(np.ascontiguousarray(bc.X_binned))
         row += Xc.shape[0]
-    if row != n_total:
+    if row != n_local:
         raise LightGBMError(
             "source is not restartable: round 2 yielded %d rows, round 1 "
             "saw %d — reset() must rewind to the identical chunk stream"
-            % (row, n_total))
+            % (row, n_local))
 
     sd = StreamedDataset()
     sd.__dict__.update(proto.__dict__)
@@ -168,9 +257,22 @@ def ingest(source: ChunkSource, config,
     sd.chunks = chunks
     sd.num_data = n_total
     sd.metadata = Metadata(n_total)
-    if labels:
+    if comm is not None:
+        sd.shard_rank = shard_rank
+        sd.shard_world = shard_world
+        sd.shard_row_counts = shard_row_counts
+        sd.shard_comm = comm
+        if global_label is not None:
+            # every rank holds the FULL label vector: host-side label
+            # statistics (boost_from_average, is_unbalance, metrics) then
+            # agree bit-for-bit across ranks with zero further comm
+            sd.metadata.set_label(global_label)
+    elif labels:
         sd.metadata.set_label(np.concatenate(labels))
     Log.info("stream: ingested %d rows in %d chunks (%d stored columns, "
-             "sample=%d rows)", n_total, len(chunks),
-             chunks[0].shape[1] if chunks else 0, len(sample_rows))
+             "sample=%d rows%s)", n_total, len(chunks),
+             chunks[0].shape[1] if chunks else 0, sample_mat.shape[0],
+             (", shard %d/%d with %d local rows"
+              % (shard_rank, shard_world, n_local)
+              if comm is not None else ""))
     return sd

@@ -90,6 +90,7 @@ class ArraySource(ChunkSource):
                 raise LightGBMError(
                     "label length %d does not match %d data rows"
                     % (len(self._y), self._X.shape[0]))
+        self.num_rows = int(self._X.shape[0])
 
     def reset(self) -> None:
         pass
@@ -124,6 +125,7 @@ class NpyMmapSource(ChunkSource):
                 "%s should hold a 2-D matrix, got shape %s"
                 % (path, mm.shape))
         self._shape = mm.shape
+        self.num_rows = int(mm.shape[0])
         del mm
         self._y: Optional[np.ndarray] = None
         if isinstance(label, str):
@@ -151,6 +153,97 @@ class NpyMmapSource(ChunkSource):
                 yield X, y
         finally:
             del mm
+
+
+def shard_offsets(total_rows: int, world: int) -> List[int]:
+    """The canonical shard-assignment contract: ``world`` CONTIGUOUS
+    rank-ordered row blocks, shard ``p`` owning rows
+    ``[off[p], off[p+1])`` with ``off[p] = floor(p * N / world)``.
+    Concatenating the shards in rank order reproduces the original row
+    order — which is what makes the allgathered bin-boundary sample and
+    the rank-folded checkpoint fingerprint well defined (the same
+    contract as the reference's distributed row partition,
+    dataset_loader.cpp:469-495, minus the dropped remainder rows)."""
+    check(world >= 1, "shard world should be >= 1, got %d" % world)
+    check(total_rows >= world,
+          "cannot shard %d rows over %d processes (every shard needs at "
+          "least one row)" % (total_rows, world))
+    return [total_rows * p // world for p in range(world + 1)]
+
+
+class ShardedSource(ChunkSource):
+    """One rank's contiguous row block of an inner ``ChunkSource``.
+
+    Wraps any restartable source and yields only the rows in
+    ``[offsets[rank], offsets[rank+1])``, re-chunked to the inner
+    source's ``chunk_rows`` bound. The inner source is still streamed in
+    full (chunk row counts are only known by reading), but rows outside
+    the shard are dropped immediately, so peak memory stays one chunk.
+
+    ``total_rows`` must be known up front (``ArraySource`` /
+    ``NpyMmapSource`` know theirs; text sources need it passed
+    explicitly) unless explicit ``offsets`` are given — the hook the
+    skewed-shard tests use.
+    """
+
+    def __init__(self, inner: ChunkSource, rank: int, world: int,
+                 total_rows: Optional[int] = None,
+                 offsets: Optional[List[int]] = None):
+        self.inner = inner
+        self.chunk_rows = inner.chunk_rows
+        self.shard_rank = int(rank)
+        self.shard_world = int(world)
+        check(0 <= self.shard_rank < self.shard_world,
+              "shard rank %d out of range for world %d"
+              % (self.shard_rank, self.shard_world))
+        if offsets is not None:
+            offs = [int(o) for o in offsets]
+            check(len(offs) == self.shard_world + 1,
+                  "explicit shard offsets need world+1=%d entries, got %d"
+                  % (self.shard_world + 1, len(offs)))
+            check(offs[0] == 0 and
+                  all(offs[i] < offs[i + 1] for i in range(len(offs) - 1)),
+                  "shard offsets must start at 0 and strictly increase "
+                  "(every shard needs at least one row), got %s" % (offs,))
+        else:
+            if total_rows is None:
+                total_rows = getattr(inner, "num_rows", None)
+            check(total_rows is not None,
+                  "ShardedSource needs total_rows (or explicit offsets) "
+                  "for a source that cannot report its row count up front")
+            offs = shard_offsets(int(total_rows), self.shard_world)
+        self.offsets = offs
+        self.total_rows = offs[-1]
+
+    @property
+    def feature_names(self):  # inner may learn names on first read
+        return self.inner.feature_names
+
+    @feature_names.setter
+    def feature_names(self, v):
+        self.inner.feature_names = v
+
+    def reset(self) -> None:
+        self.inner.reset()
+
+    def __iter__(self) -> Iterator[Chunk]:
+        lo = self.offsets[self.shard_rank]
+        hi = self.offsets[self.shard_rank + 1]
+        pos = 0
+        for Xc, yc in self.inner:
+            n = Xc.shape[0]
+            a = max(lo - pos, 0)
+            b = min(hi - pos, n)
+            if a < b:
+                yield (Xc[a:b],
+                       yc[a:b] if yc is not None else None)
+            pos += n
+            if pos >= hi:
+                break
+        check(pos >= hi,
+              "sharded source exhausted at row %d before reaching shard "
+              "end %d — total_rows/offsets overstate the inner source"
+              % (pos, hi))
 
 
 class CsvSource(ChunkSource):

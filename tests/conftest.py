@@ -35,8 +35,9 @@ import pytest
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "slow: heavy multi-device training runs excluded from the tier-1 "
-        "fast suite (run with -m slow)")
+        "slow: heavy tests (long training runs, multi-device meshes, "
+        "fuzz sweeps) excluded from the tier-1 fast suite so it fits the "
+        "870s budget; run the full suite with -m '' or just -m slow")
 
 
 @pytest.fixture

@@ -339,6 +339,7 @@ def test_committed_baseline_is_wellformed():
 
 
 # ------------------------------------------------- donation regression
+@pytest.mark.slow
 def test_train_block_donation_actually_aliased():
     """Satellite 2: train_many's donated scores/bag-mask buffers are
     really input-output aliased in the compiled executable — XLA

@@ -8,6 +8,7 @@ import lightgbm_tpu as lgb
 from conftest import make_binary, make_regression, make_multiclass, make_ranking
 
 
+@pytest.mark.slow
 def test_train_basic_binary():
     X, y = make_binary()
     train = lgb.Dataset(X, label=y)
@@ -20,6 +21,7 @@ def test_train_basic_binary():
     assert roc_auc_score(y, pred) > 0.95
 
 
+@pytest.mark.slow
 def test_train_with_valid_and_evals_result():
     X, y = make_binary(n=1500)
     Xv, yv = make_binary(n=500, seed=99)
@@ -35,6 +37,7 @@ def test_train_with_valid_and_evals_result():
     assert len(evals["valid_0"]["auc"]) == 10
 
 
+@pytest.mark.slow
 def test_early_stopping():
     X, y = make_binary(n=1500)
     Xv, yv = make_binary(n=500, seed=99)
@@ -49,6 +52,7 @@ def test_early_stopping():
     assert "binary_logloss" in bst.best_score["valid_0"]
 
 
+@pytest.mark.slow
 def test_save_load_predict_roundtrip(tmp_path):
     X, y = make_regression()
     train = lgb.Dataset(X, label=y)
@@ -75,6 +79,7 @@ def test_dump_model_json():
     assert "tree_structure" in d["tree_info"][0]
 
 
+@pytest.mark.slow
 def test_custom_fobj_feval():
     X, y = make_regression()
     train = lgb.Dataset(X, label=y)
@@ -95,6 +100,7 @@ def test_custom_fobj_feval():
     assert evals["training"]["mae"][-1] < evals["training"]["mae"][0]
 
 
+@pytest.mark.slow
 def test_continue_training_from_init_model(tmp_path):
     X, y = make_regression()
     train = lgb.Dataset(X, label=y, free_raw_data=False)
@@ -113,6 +119,7 @@ def test_continue_training_from_init_model(tmp_path):
     assert bst1.num_trees() == 5
 
 
+@pytest.mark.slow
 def test_cv():
     X, y = make_binary(n=1200)
     res = lgb.cv({"objective": "binary", "metric": "auc", "verbosity": -1},
@@ -178,6 +185,7 @@ def test_ranking_through_api():
     assert evals["training"]["ndcg@5"][-1] > evals["training"]["ndcg@5"][0] - 1e-9
 
 
+@pytest.mark.slow
 def test_multiclass_through_api():
     X, y = make_multiclass(k=3)
     bst = lgb.train({"objective": "multiclass", "num_class": 3,
@@ -196,6 +204,7 @@ def test_learning_rates_schedule():
     assert bst.current_iteration == 6
 
 
+@pytest.mark.slow
 def test_prediction_early_stop():
     """Margin-based prediction early stop (prediction_early_stop.cpp):
     approximate, but high-margin rows must agree with full predict."""
@@ -217,6 +226,7 @@ def test_prediction_early_stop():
     assert ((es > 0.5) == (full > 0.5))[confident].all()
 
 
+@pytest.mark.slow
 def test_get_split_value_histogram():
     from conftest import make_regression
     X, y = make_regression(n=1500)
@@ -252,6 +262,7 @@ def test_sparse_predict_blocks_not_densified():
     np.testing.assert_array_equal(l_sparse, l_dense)
 
 
+@pytest.mark.slow
 def test_sparse_refit_matches_dense_refit():
     from scipy import sparse as sp
     import lightgbm_tpu as lgb

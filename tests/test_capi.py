@@ -30,6 +30,7 @@ def lib_path():
     return LIB
 
 
+@pytest.mark.slow
 def test_c_host_end_to_end(lib_path, tmp_path):
     """Compile the C smoke program and run it as its own process."""
     exe = str(tmp_path / "capi_smoke")
@@ -536,6 +537,7 @@ def test_capi_csc_create(lib_path):
     lib.LGBM_DatasetFree(ds)
 
 
+@pytest.mark.slow
 def test_csr_func_callback_constructor(lib_path, tmp_path):
     """LGBM_DatasetCreateFromCSRFunc (c_api.h:156-165): a C++ host hands a
     std::function row iterator across the ABI; the callback-built dataset

@@ -119,6 +119,7 @@ def test_cegb_lazy_prefers_paid_rows(xy):
     assert len(_used_features(bst)) <= len(_used_features(free))
 
 
+@pytest.mark.slow
 def test_forced_splits_match_on_data_parallel_mesh(tmp_path, xy):
     """Forced splits now ride the fused sharded partition path (the leaf
     rebuild runs straight-line + psum, grow.py leaf_hist): an 8-shard

@@ -70,6 +70,7 @@ def test_bundle_offsets_layout():
     assert offs1 == [0] and total1 == 17
 
 
+@pytest.mark.slow
 def test_sparse_input_bundles_and_matches_dense():
     X, y = _exclusive_groups()
     Xs = sp.csr_matrix(X)

@@ -68,6 +68,7 @@ def test_refit_decay_one_is_byte_stable():
         np.testing.assert_array_equal(old, new)
 
 
+@pytest.mark.slow
 def test_refit_device_matches_host_golden_binary():
     bst, X, y = _binary_booster()
     rng = np.random.RandomState(0)

@@ -172,6 +172,8 @@ def test_bucketed_matches_fixed_width_dense():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_bucketed_matches_fixed_width_efb():
     """Exclusive sparse blocks: EFB bundling rewrites the column layout the
     wave sweeps, so pin identity on the bundled path too."""
@@ -239,6 +241,8 @@ def test_bucketed_matches_fixed_width_sharded_skewed():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_max_depth_clamp_end_to_end():
     """Regression for the clamp bugfix: with a binding max_depth the wave
     ladder tops out at 2^(d-1), and the grown trees respect the depth cap
@@ -262,6 +266,8 @@ def test_max_depth_clamp_end_to_end():
 
 
 # ------------------------------------------------- probe + compile metrics
+@pytest.mark.slow
+@pytest.mark.slow
 def test_phase_probe_reports_occupancy_and_cache():
     from lightgbm_tpu.profiling import phase_probe
     X, y = make_binary(n=2000)
@@ -283,6 +289,8 @@ def test_phase_probe_reports_occupancy_and_cache():
     assert "frontier_hist_w1" in phases and "frontier_hist_w14" in phases
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_zero_recompiles_after_warmup_in_process(tmp_path):
     """The measured invariant the cache work exists for: after one
     train_many block (which pre-warms the wave ladder — the eager ladder
@@ -319,6 +327,8 @@ def test_zero_recompiles_after_warmup_in_process(tmp_path):
 
 
 # ---------------------------------------------------- checkpoint identity
+@pytest.mark.slow
+@pytest.mark.slow
 def test_checkpoint_resume_byte_identical_frontier(tmp_path):
     """Checkpoint/resume must stay byte-identical when the frontier grower
     (bucketed by default) is the training path."""

@@ -57,6 +57,8 @@ def test_batch_one_matches_exact_structure():
 
 
 @pytest.mark.parametrize("kb", [4, 16])
+@pytest.mark.slow
+@pytest.mark.slow
 def test_batched_quality_close_to_exact(kb):
     X, y = make_binary(n=4000)
     base = {"objective": "binary", "num_leaves": 63, "metric": "auc",
@@ -89,6 +91,8 @@ def test_batched_predict_matches_train_scores():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_batched_multiclass():
     X, y = make_multiclass()
     base = {"objective": "multiclass", "num_class": 4,
@@ -157,6 +161,8 @@ def test_batched_slot_kernel_end_to_end():
     np.testing.assert_allclose(ps, pp, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_batched_pack_matches_unpacked():
     """tpu_batched_pack (active rows packed to the front + tile-skip slot
     kernel) reorders rows feeding the histogram sums, so models must

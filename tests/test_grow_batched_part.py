@@ -37,6 +37,7 @@ BASE = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
         "tree_batch_splits": 4, "tpu_hist_impl": "scatter"}
 
 
+@pytest.mark.slow
 def test_part_matches_plain_batched_structure():
     X, y = make_binary(n=3000)
     b0 = _train(X, y, dict(BASE))
@@ -55,6 +56,7 @@ def test_part_matches_plain_batched_structure():
     np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_part_kernel_matches_fallback():
     """The tile-pure kernel (interpret) vs the combined-index scatter
     build, end to end. n spans multiple 2048-row tiles so segments
@@ -68,6 +70,7 @@ def test_part_kernel_matches_fallback():
     np.testing.assert_allclose(ps, pp, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_part_data_parallel_matches_single_device():
     import jax
     if len(jax.devices()) < 8:
@@ -98,6 +101,7 @@ def test_local_slot_mask_semantics():
     np.testing.assert_array_equal(np.asarray(m), [False, False, False, True])
 
 
+@pytest.mark.slow
 def test_part_data_parallel_skewed_inactive_slots():
     """Data-parallel parity on a row-SORTED dataset: leaves align with
     contiguous row ranges, so nearly every (leaf, shard) pair has zero

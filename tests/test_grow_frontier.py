@@ -103,6 +103,7 @@ def test_frontier_fills_leaf_budget():
     assert b.models[0].num_leaves == 33
 
 
+@pytest.mark.slow
 def test_frontier_sweeps_scale_with_depth():
     """The whole point: dataset sweeps per tree = max leaf depth + 1,
     not num_leaves - 1 (ISSUE 2 acceptance)."""
@@ -122,6 +123,7 @@ def test_frontier_sweeps_scale_with_depth():
     assert phases["frontier_sweeps_per_tree"] < b.models[0].num_leaves - 1
 
 
+@pytest.mark.slow
 def test_frontier_predict_matches_train_scores():
     X, y = make_binary(n=1500)
     b = _train(X, y, {"objective": "binary", "tree_growth": "frontier",

@@ -1,6 +1,7 @@
 """Capped histogram pool (histogram_pool_size): LRU slots + rebuild-on-miss
 must reproduce the unlimited pool's model (HistogramPool,
 feature_histogram.hpp:646-820)."""
+import pytest
 import numpy as np
 
 import lightgbm_tpu as lgb
@@ -47,6 +48,7 @@ def test_pool_cap_larger_than_needed_is_uncapped():
     assert big._impl.grow_params.pool_slots == 0
 
 
+@pytest.mark.slow
 def test_capped_pool_multiclass():
     """Capped multiclass takes the sequential-classes path (lax.map)."""
     rng = np.random.RandomState(5)

@@ -23,6 +23,7 @@ def _mixed_xy(n=4000, seed=0):
     return X, y
 
 
+@pytest.mark.slow
 def test_packing_reduces_columns_and_matches_structure():
     X, y = _mixed_xy()
     params = {"objective": "binary", "verbosity": -1, "num_leaves": 31}
@@ -57,6 +58,7 @@ def test_packing_skipped_when_it_would_widen_b():
     assert roc_auc_score(y, bst.predict(X)) > 0.95
 
 
+@pytest.mark.slow
 def test_packing_with_missing_values():
     X, y = _mixed_xy(seed=2)
     X[::7, 3] = np.nan                # NaN in a packed small feature

@@ -113,6 +113,7 @@ def test_data_parallel_uses_sharded_partition():
         os.unlink(path)
 
 
+@pytest.mark.slow
 def test_feature_parallel_matches_serial():
     X, y = make_binary(n=1500)
     serial = _train({"objective": "binary", "metric": "auc",
@@ -124,6 +125,7 @@ def test_feature_parallel_matches_serial():
     assert abs(auc_s - auc_f) < 1e-3
 
 
+@pytest.mark.slow
 def test_voting_parallel_close_to_serial():
     """PV-Tree voting (voting_parallel_tree_learner.cpp) is approximate —
     the elected candidate set can miss the global best — but with top_k >=
@@ -235,6 +237,7 @@ def test_goss_under_mesh_uses_real_counts():
     assert abs(auc_m - auc_s) < 0.05
 
 
+@pytest.mark.slow
 def test_explicit_feature_parallel_engaged_and_matches():
     """The EXPLICIT feature-parallel learner (bin-balanced column
     assignment + argmax-allreduce of split structs, grow.sync_best_split —
@@ -387,6 +390,7 @@ def test_voting_reduces_only_elected_histograms():
     assert any(s[0] == 2 * top_k for s in big), big
 
 
+@pytest.mark.slow
 def test_voting_on_2d_mesh_slow_axis():
     """Multi-slice-shaped config: a [4, 2] (data x feature) mesh with the
     PV-Tree vote riding the SLOW (data) axis — the deployment the voting

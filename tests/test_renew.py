@@ -1,6 +1,7 @@
 """Device-side RenewTreeOutput (core/renew.py): the in-graph segmented
 weighted percentile must agree with the host _weighted_percentile on every
 leaf, including empty leaves and masked-out rows."""
+import pytest
 import numpy as np
 import jax.numpy as jnp
 
@@ -8,6 +9,7 @@ from lightgbm_tpu.core.renew import renew_leaf_values
 from lightgbm_tpu.objectives import _weighted_percentile
 
 
+@pytest.mark.slow
 def test_renew_matches_host_percentile_fuzz():
     r = np.random.RandomState(0)
     for trial in range(30):

@@ -159,6 +159,8 @@ def test_served_matches_reference_multiclass_pred_file():
 
 
 # ---------------------------------------------------------- zero recompile
+@pytest.mark.slow
+@pytest.mark.slow
 def test_zero_recompiles_after_warmup():
     """The tentpole property: warmup enumerates every (bucket, raw) entry,
     then randomized-size traffic never compiles again — asserted on BOTH

@@ -27,6 +27,7 @@ def test_classifier_fit_predict_proba():
     assert list(clf.classes_) == [0, 1]
 
 
+@pytest.mark.slow
 def test_regressor_early_stopping_sets_best_iteration():
     X, y = _xy()
     yr = X[:, 0] * 2 + 0.05 * np.random.RandomState(1).randn(len(y))

@@ -126,6 +126,8 @@ def test_streamed_matches_single_shot_skewed_last_chunk():
     assert _struct(a.model_to_string()) == _struct(b.model_to_string())
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_streamed_chunk_rows_ge_n_degenerates_to_single_chunk():
     X, y = _data(n=1500)
     a = _train(_BASE, X, y)
@@ -136,6 +138,8 @@ def test_streamed_chunk_rows_ge_n_degenerates_to_single_chunk():
     assert len(ds.construct()._binned.chunks) == 1
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_streamed_matches_single_shot_categorical_and_efb():
     X, y = _data(categorical=True, seed=3)
     # two sparse exclusive-ish columns make EFB bundling kick in
@@ -149,6 +153,8 @@ def test_streamed_matches_single_shot_categorical_and_efb():
     assert _struct(a.model_to_string()) == _struct(b.model_to_string())
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_streamed_multiclass_parity():
     X, _ = _data(seed=5)
     r = np.random.RandomState(5)
@@ -160,6 +166,8 @@ def test_streamed_multiclass_parity():
     assert _struct(a.model_to_string()) == _struct(b.model_to_string())
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_streamed_bagging_goss_parity_with_per_iteration_baseline():
     """Bagging / GOSS draw their keys from the per-iteration split chain;
     the fused-block path uses a different (batched) chain, so the
@@ -174,6 +182,8 @@ def test_streamed_bagging_goss_parity_with_per_iteration_baseline():
         assert _struct(a.model_to_string()) == _struct(b.model_to_string())
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_streamed_npy_and_csv_sources_match_array(tmp_path):
     X, y = _data(n=1200)
     p = dict(_BASE, data_stream_chunk_rows=500)
@@ -369,6 +379,8 @@ def test_streamed_fingerprint_semantics():
     assert dataset_fingerprint(d1) != dataset_fingerprint(d3)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_streamed_resume_byte_identical(tmp_path):
     from lightgbm_tpu import callback, engine
     X, y = _data(n=1500)

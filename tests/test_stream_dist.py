@@ -1,0 +1,413 @@
+"""Chunks x chips: out-of-core streaming composed with the mesh learners
+(stream/grow_stream.py mesh mode, stream/pipeline.py ShardedChunkPipeline,
+gbdt._setup_stream_mesh).
+
+Contracts pinned here (the 2-process leg lives in
+tools/dist_train_smoke.py --only stream, this file runs on the 8
+virtual-device single-process mesh + LoopbackComm thread ranks):
+
+- sharded streamed training is STRUCTURE-IDENTICAL to serial streamed
+  training for both learner schedules (data reduce-scatter, voting),
+  including ragged last chunks, label-sorted (distribution-skewed)
+  shards, column counts that need padding for the reduce-scatter tile,
+  and multiclass;
+- voting with top_k >= F degenerates to the exact data-parallel search;
+- every unsupported-combo gate refuses BY NAME (config spelling gates +
+  the gbdt topology gates) instead of the old blanket refusal;
+- sharded ingest reproduces the in-memory loader's drift profile
+  bit-identically (per-shard bin-occupancy counts summed over the comm);
+- the checkpoint fingerprint folds rank-ordered shard digests: identical
+  layout reproduces it, a reshuffled shard assignment refuses resume;
+- kill-and-resume under the mesh is byte-identical;
+- the compiled-program count is invariant in chunk count under the mesh.
+"""
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.log import LightGBMError
+
+from test_stream import _BASE, _data, _struct
+
+
+
+def _train(params, X, y, rounds=4, **dskw):
+    return lgb.train(dict(params), lgb.Dataset(X, label=y, **dskw),
+                     num_boost_round=rounds)
+
+
+def _mesh_params(extra=None, chunk_rows=160, mesh=2, learner="data"):
+    p = dict(_BASE, data_stream_chunk_rows=chunk_rows,
+             tree_learner=learner, mesh_shape=[mesh],
+             num_machines=mesh)
+    p.update(extra or {})
+    return p
+
+
+# ---------------------------------------------- structure identity
+@pytest.mark.slow
+def test_data_mesh_structure_identical_to_serial_streamed():
+    X, y = _data(n=700, f=12)
+    serial = _struct(_train(dict(_BASE, data_stream_chunk_rows=160),
+                            X, y).model_to_string())
+    meshed = _struct(_train(_mesh_params(), X, y).model_to_string())
+    assert serial == meshed
+
+
+def test_voting_mesh_structure_identical_small_topk():
+    X, y = _data(n=700, f=12)
+    serial = _struct(_train(dict(_BASE, data_stream_chunk_rows=160),
+                            X, y).model_to_string())
+    meshed = _struct(_train(_mesh_params({"top_k": 4}, learner="voting"),
+                            X, y).model_to_string())
+    assert serial == meshed
+
+
+def test_voting_topk_ge_features_degenerates_to_data_parallel():
+    """top_k >= F elects every feature: the vote is a no-op and the
+    committed trees match the exact data-parallel (== serial) search."""
+    X, y = _data(n=700, f=13)
+    serial = _struct(_train(dict(_BASE, data_stream_chunk_rows=96),
+                            X, y).model_to_string())
+    meshed = _struct(_train(
+        _mesh_params({"top_k": 13}, chunk_rows=96, learner="voting"),
+        X, y).model_to_string())
+    assert serial == meshed
+
+
+def test_mesh4_ragged_chunks_and_column_padding():
+    """mesh=4 with 13 stored columns forces the reduce-scatter column
+    pad (13 % 4 != 0) AND a ragged last chunk per shard (701 rows)."""
+    X, y = _data(n=701, f=13)
+    serial = _struct(_train(dict(_BASE, data_stream_chunk_rows=96),
+                            X, y).model_to_string())
+    meshed = _struct(_train(_mesh_params(chunk_rows=96, mesh=4),
+                            X, y).model_to_string())
+    assert serial == meshed
+
+
+@pytest.mark.slow
+def test_label_sorted_rows_skewed_shards_identical():
+    """Label-sorted rows deal each shard a maximally skewed class
+    distribution (shard 0 almost all negatives); histograms are summed
+    across the mesh before any decision, so structure must not move."""
+    X, y = _data(n=900, f=10, seed=3)
+    order = np.argsort(y, kind="stable")
+    X, y = X[order], y[order]
+    serial = _struct(_train(dict(_BASE, data_stream_chunk_rows=128),
+                            X, y).model_to_string())
+    for learner, extra in (("data", None), ("voting", {"top_k": 4})):
+        meshed = _struct(_train(
+            _mesh_params(extra, chunk_rows=128, learner=learner),
+            X, y).model_to_string())
+        assert serial == meshed, learner
+
+
+def test_multiclass_mesh_identical():
+    # 2 rounds x 3 classes: structure identity holds at these seeds;
+    # deeper runs can legitimately diverge on f32 gain near-ties (the
+    # documented chunked-accumulation boundary, docs/OutOfCore.md)
+    r = np.random.RandomState(3)
+    n, f = 701, 13
+    X = r.randn(n, f)
+    y3 = r.randint(0, 3, n).astype(np.float64)
+    p = dict(_BASE, objective="multiclass", num_class=3,
+             data_stream_chunk_rows=96)
+    serial = _struct(_train(p, X, y3, rounds=2).model_to_string())
+    meshed = _struct(_train(dict(p, tree_learner="data", mesh_shape=[2],
+                                 num_machines=2), X, y3,
+                            rounds=2).model_to_string())
+    assert serial == meshed
+
+
+def test_chunk_count_invariance_of_structure_under_mesh():
+    """Same rows at 2 vs 4 chunks per shard commit identical structure
+    (histograms are additive over chunks; the collective fires once per
+    wave either way)."""
+    X, y = _data(n=640, f=8)
+    a = _struct(_train(_mesh_params(chunk_rows=160), X, y)
+                .model_to_string())
+    b = _struct(_train(_mesh_params(chunk_rows=80), X, y)
+                .model_to_string())
+    assert a == b
+
+
+def test_compiled_program_count_invariant_in_chunk_count():
+    """Fresh boosters at 2 vs 4 chunks/shard compile the same NUMBER of
+    programs (fixed-shape per-chunk kernels; chunk count only changes
+    how often each one runs)."""
+    from lightgbm_tpu.profiling import (backend_compile_count,
+                                        install_compile_hook)
+    install_compile_hook()
+    X, y = _data(n=640, f=8)
+    _train(_mesh_params(chunk_rows=320), X, y, rounds=2)  # warm helpers
+    c0 = backend_compile_count()
+    _train(_mesh_params(chunk_rows=160), X, y, rounds=2)
+    c2 = backend_compile_count() - c0
+    c0 = backend_compile_count()
+    _train(_mesh_params(chunk_rows=80), X, y, rounds=2)
+    c4 = backend_compile_count() - c0
+    assert c4 - c2 == 0, (c2, c4)
+
+
+# ---------------------------------------------- gates, each by name
+def test_gate_streamed_feature_learner():
+    with pytest.raises(LightGBMError, match="streamed\\+feature-learner"):
+        Config(dict(_BASE, data_stream_chunk_rows=100,
+                    tree_learner="feature", mesh_shape=[2]))
+
+
+def test_gate_streamed_mesh_f64():
+    with pytest.raises(LightGBMError, match="streamed-mesh\\+f64"):
+        Config(dict(_BASE, data_stream_chunk_rows=100, gpu_use_dp=True,
+                    tree_learner="data", mesh_shape=[2]))
+
+
+def test_gate_streamed_f64_without_mesh():
+    with pytest.raises(LightGBMError, match="gpu_use_dp"):
+        Config(dict(_BASE, data_stream_chunk_rows=100, gpu_use_dp=True))
+
+
+def test_gate_streamed_feature_axis_mesh():
+    X, y = _data(n=400, f=8)
+    with pytest.raises(LightGBMError, match="feature axis"):
+        _train(dict(_BASE, data_stream_chunk_rows=100,
+                    tree_learner="data", mesh_shape=[2, 2]), X, y,
+               rounds=1)
+
+
+def test_gate_sharded_dataset_without_mesh():
+    sds = _sharded_ingest_pair(dict(_BASE, data_stream_chunk_rows=100))
+    with pytest.raises(LightGBMError, match="no mesh is configured"):
+        _train_binned(sds[0], dict(_BASE, data_stream_chunk_rows=100))
+
+
+def test_gate_shard_world_mesh_size_mismatch():
+    p = dict(_BASE, data_stream_chunk_rows=100, tree_learner="data",
+             mesh_shape=[4], num_machines=4)
+    sds = _sharded_ingest_pair(p)
+    with pytest.raises(LightGBMError,
+                       match="must equal the data-axis size"):
+        _train_binned(sds[0], p)
+
+
+def test_gate_sharded_single_process_shard_mismatch():
+    """A 2-way-sharded dataset on a single-process mesh of 2: the one
+    process addresses BOTH mesh positions but holds only shard 0's
+    chunks — the pipeline refuses the topology."""
+    p = dict(_BASE, data_stream_chunk_rows=100, tree_learner="data",
+             mesh_shape=[2], num_machines=2)
+    sds = _sharded_ingest_pair(p)
+    with pytest.raises(LightGBMError):
+        _train_binned(sds[0], p)
+
+
+# ---------------------------------------------- sharded-ingest helpers
+def _sharded_ingest_pair(params, X=None, y=None, offsets=None):
+    """Ingest the same data as 2 LoopbackComm thread ranks; returns the
+    per-rank StreamedDatasets (collective-capable: their shard_comm is
+    the live loopback group, so later collective calls must run in BOTH
+    threads — see _collective_pair)."""
+    from lightgbm_tpu.parallel.network import LoopbackComm
+    from lightgbm_tpu.stream.sampler import ingest
+    from lightgbm_tpu.stream.source import ArraySource, ShardedSource
+    if X is None:
+        X, y = _data(n=600, f=6)
+    cfg = Config(dict(params))
+    comms = LoopbackComm.group(2, timeout_s=30)
+    out = [None, None]
+    err = []
+
+    def run(rank):
+        try:
+            src = ShardedSource(
+                ArraySource(X, label=y, chunk_rows=90), rank, 2,
+                offsets=offsets)
+            out[rank] = ingest(src, cfg, comm=comms[rank])
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            comms[rank].abort()
+            err.append((rank, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    assert not err, err
+    return out
+
+
+def _collective_pair(sds, fn):
+    """Run ``fn(rank, sd)`` in both thread ranks (lockstep, so comm
+    collectives inside fn line up); returns [result0, result1]."""
+    out = [None, None]
+    err = []
+
+    def run(rank):
+        try:
+            out[rank] = fn(rank, sds[rank])
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            sds[rank].shard_comm.abort()
+            err.append((rank, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    assert not err, err
+    return out
+
+
+def _train_binned(sd, params):
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+    cfg = Config(dict(params))
+    b = create_boosting(cfg, sd, create_objective(cfg), [])
+    b.train_one_iter()
+    return b
+
+
+# ---------------------------------------------- drift profile parity
+def test_sharded_drift_profile_matches_in_memory_loader():
+    from lightgbm_tpu.obs.drift import DataProfile
+    X, y = _data(n=900, f=6, seed=8)
+    p = dict(_BASE, data_stream_chunk_rows=150)
+    full = lgb.Dataset(X, label=y, params=dict(_BASE)) \
+        .construct()._binned
+    want = DataProfile.from_binned_dataset(full)
+    sds = _sharded_ingest_pair(p, X=X, y=y)
+    profs = _collective_pair(sds, lambda rank, sd: sd.data_profile())
+    for prof in profs:
+        assert prof.num_data == want.num_data
+        assert prof.features == want.features   # bit-identical counts
+
+
+# ---------------------------------------------- fingerprint semantics
+def _fingerprints(sds):
+    from lightgbm_tpu.checkpoint.snapshot import dataset_fingerprint
+    return _collective_pair(sds,
+                            lambda rank, sd: dataset_fingerprint(sd))
+
+
+def test_sharded_fingerprint_accepts_identical_layout():
+    X, y = _data(n=600, f=6, seed=4)
+    p = dict(_BASE, data_stream_chunk_rows=100)
+    fp_a = _fingerprints(_sharded_ingest_pair(p, X=X, y=y))
+    fp_b = _fingerprints(_sharded_ingest_pair(p, X=X, y=y))
+    # every rank computes the SAME folded fingerprint, and the identical
+    # layout reproduces it exactly across runs
+    assert fp_a[0] == fp_a[1] == fp_b[0] == fp_b[1]
+
+
+def test_sharded_fingerprint_refuses_reshuffled_shards():
+    """Same global rows dealt to the ranks at a different boundary: the
+    rank-ordered (rank, digest, rows) folding must change, so resume
+    refuses the reshuffled assignment."""
+    from lightgbm_tpu.checkpoint.snapshot import check_compatibility
+    X, y = _data(n=600, f=6, seed=4)
+    p = dict(_BASE, data_stream_chunk_rows=100)
+    fp_even = _fingerprints(_sharded_ingest_pair(p, X=X, y=y))
+    skew = _sharded_ingest_pair(p, X=X, y=y, offsets=[0, 150, 600])
+    fp_skew = _fingerprints(skew)
+    assert fp_skew[0] == fp_skew[1]
+    assert fp_even[0] != fp_skew[0]
+    # the fingerprint is cached on the dataset after _fingerprints, so
+    # the compatibility check below runs comm-free on one rank
+    with pytest.raises(LightGBMError, match="different dataset"):
+        check_compatibility({"dataset_fingerprint": fp_even[0]},
+                            Config(dict(p)), skew[0])
+
+
+# ---------------------------------------------- checkpoint resume
+@pytest.mark.slow
+def test_mesh_streamed_resume_byte_identical(tmp_path):
+    from lightgbm_tpu import callback, engine
+    X, y = _data(n=700, f=8)
+    p = _mesh_params(chunk_rows=128)
+
+    def run(ckpt, rounds, resume=False):
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+        return engine.train(dict(p), ds, num_boost_round=rounds,
+                            callbacks=[callback.checkpoint(ckpt,
+                                                           period=1)],
+                            resume_from=(ckpt if resume else None),
+                            verbose_eval=False)
+
+    golden = run(str(tmp_path / "g"), 5)
+    run(str(tmp_path / "i"), 2)
+    resumed = run(str(tmp_path / "i"), 5, resume=True)
+    assert golden.model_to_string() == resumed.model_to_string()
+
+
+# ---------------------------------------------- pipeline unit seams
+def test_split_chunks_rows_and_padded_layout_roundtrip():
+    from lightgbm_tpu.stream.pipeline import (shard_rows_host,
+                                              shard_rows_perm,
+                                              split_chunks_rows)
+    r = np.random.RandomState(0)
+    chunks = [r.randint(0, 9, (c, 3)).astype(np.uint8)
+              for c in (50, 31, 19)]
+    flat = np.concatenate(chunks)
+    offsets = [0, 23, 100]                      # skewed 23 / 77 split
+    per_shard = split_chunks_rows(chunks, offsets)
+    assert [sum(c.shape[0] for c in s) for s in per_shard] == [23, 77]
+    np.testing.assert_array_equal(
+        np.concatenate([c for s in per_shard for c in s]), flat)
+
+    vals = r.randn(100).astype(np.float32)
+    local_padded = 80                           # both shards fit in 80
+    padded = shard_rows_host(vals, offsets, local_padded)
+    assert padded.shape == (160,)
+    perm = shard_rows_perm(offsets, local_padded)
+    np.testing.assert_array_equal(padded[perm], vals)
+    # rows outside every shard's block are exactly zero
+    mask = np.ones(160, bool)
+    mask[perm] = False
+    assert not np.any(padded[mask])
+
+
+def test_train_set_metric_eval_under_single_process_mesh():
+    """get_eval_at(0) must unpermute the shard-major padded scores back
+    to original row order — pinned by matching the serial streamed
+    metric exactly."""
+    from lightgbm_tpu import engine
+    X, y = _data(n=600, f=8)
+
+    def logloss(params):
+        ev = {}
+        ds = lgb.Dataset(X, label=y, params=dict(params))
+        engine.train(dict(params, metric="binary_logloss"), ds,
+                     num_boost_round=3, valid_sets=[ds],
+                     valid_names=["train"], evals_result=ev,
+                     verbose_eval=False)
+        return ev["train"]["binary_logloss"]
+
+    serial = logloss(dict(_BASE, data_stream_chunk_rows=128))
+    meshed = logloss(_mesh_params(chunk_rows=128))
+    np.testing.assert_allclose(serial, meshed, rtol=1e-6)
+
+
+def test_streamed_wave_collective_schedule_pinned():
+    """The chunks-x-chips comm contract, statically: one traced growth
+    wave carries exactly ONE extra collective over the in-memory learner
+    schedule — the int32 psum'd continue flag — and its f32 payload
+    equals the in-memory per-wave payload (streaming adds zero f32
+    traffic). Mirrors the stream_dist_* perf-gate pins."""
+    import jax
+
+    from lightgbm_tpu.analysis import jaxpr_audit
+
+    expected = {"data": 3, "voting": 4}
+    for name, overrides in (("data", {"frontier_rs": True}),
+                            ("voting", {"voting_top_k": 2})):
+        entry = jaxpr_audit.streamed_sharded_fn(param_overrides=overrides,
+                                                num_features=16)
+        assert entry is not None          # conftest forces 8 devices
+        fn, args, _ = entry
+        sched = jaxpr_audit.collective_schedule(jax.make_jaxpr(fn)(*args))
+        assert len(sched) == expected[name], (name, sched)
+        # exactly one int32 collective: the replicated continue flag
+        int_ops = [s for s in sched
+                   if all("float32" not in o for o in s["operands"])]
+        assert len(int_ops) == (1 if name == "data" else 3), (name, sched)

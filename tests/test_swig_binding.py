@@ -60,6 +60,7 @@ print("SWIG_DRIVER_OK")
 
 
 @pytest.mark.skipif(shutil.which("swig") is None, reason="no swig")
+@pytest.mark.slow
 def test_swig_python_binding_end_to_end(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # (re)generate + build against the freshly built ABI library

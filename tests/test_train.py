@@ -33,6 +33,8 @@ def test_binary_auc():
     assert res["auc"] > 0.95
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_binary_predict_matches_train_scores():
     X, y = make_binary(n=1000)
     b, ds = _train(X, y, {"objective": "binary", "verbosity": -1}, rounds=10)
@@ -41,6 +43,8 @@ def test_binary_predict_matches_train_scores():
     np.testing.assert_allclose(pred, train_scores, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_regression_l2():
     X, y = make_regression()
     b, _ = _train(X, y, {"objective": "regression", "metric": "l2",
@@ -49,6 +53,8 @@ def test_regression_l2():
     assert res["l2"] < 0.5
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_regression_l1_renews_leaves():
     X, y = make_regression()
     b, _ = _train(X, y, {"objective": "regression_l1", "metric": "l1",
@@ -57,6 +63,8 @@ def test_regression_l1_renews_leaves():
     assert res["l1"] < 0.6
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_multiclass():
     X, y = make_multiclass(k=4)
     b, _ = _train(X, y, {"objective": "multiclass", "num_class": 4,
@@ -71,6 +79,8 @@ def test_multiclass():
     assert acc > 0.85
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_lambdarank_ndcg_improves():
     X, y, group = make_ranking()
     b, _ = _train(X, y, {"objective": "lambdarank", "metric": "ndcg",
@@ -80,6 +90,8 @@ def test_lambdarank_ndcg_improves():
     assert res["ndcg@5"] > 0.80
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_weights_affect_training():
     X, y = make_binary(n=1000)
     w = np.where(y > 0, 10.0, 1.0)
@@ -90,6 +102,8 @@ def test_weights_affect_training():
     assert pred.mean() > y.mean()
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_bagging_and_feature_fraction():
     X, y = make_binary()
     b, _ = _train(X, y, {"objective": "binary", "metric": "auc",
@@ -117,6 +131,8 @@ def test_max_depth_respected():
         assert t.num_leaves_actual <= 8
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_monotone_constraints():
     r = np.random.RandomState(0)
     n = 2000
@@ -151,6 +167,8 @@ def test_constant_labels_constant_prediction():
     np.testing.assert_allclose(pred, 3.25, rtol=1e-3)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_dart_goss_rf_train():
     X, y = make_binary()
     for boost, extra in [("dart", {}), ("goss", {}),
@@ -207,6 +225,8 @@ def test_model_text_roundtrip_exact_predictions():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_feature_importance_counts_splits():
     X, y = make_binary()
     b, _ = _train(X, y, {"objective": "binary", "verbosity": -1}, rounds=10)
@@ -217,6 +237,8 @@ def test_feature_importance_counts_splits():
     assert gain_imp.sum() > 0
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_categorical_splits_improve_fit():
     """Categorical split finding (FindBestThresholdCategorical,
     feature_histogram.hpp:110-271): a feature whose categories carry signal
@@ -256,6 +278,8 @@ def test_categorical_splits_improve_fit():
                                rtol=1e-6, atol=1e-9)
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_categorical_large_values_roundtrip():
     """Category IDs above 255 (store/zip-style) must survive training,
     raw prediction, and save/load — variable-width bitsets
@@ -304,6 +328,8 @@ def test_lambdarank_bagging_samples_whole_query_groups():
     assert bb._row_group is None
 
 
+@pytest.mark.slow
+@pytest.mark.slow
 def test_lambdarank_group_bagging_parity():
     """Group-wise bagging still learns: NDCG with bagging stays close to
     the full-data run (the satellite's parity bar)."""

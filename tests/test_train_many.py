@@ -1,5 +1,6 @@
 """Fused multi-iteration training (GBDT.train_many: lax.scan over the
 iteration core — the whole boosting loop as one device program)."""
+import pytest
 import numpy as np
 from sklearn.metrics import roc_auc_score
 
@@ -14,6 +15,7 @@ def _xy(n=4000, f=8, seed=0):
     return X, y
 
 
+@pytest.mark.slow
 def test_fused_matches_per_iteration_exactly():
     """With no stochastic sampling the fused block must be bit-identical
     to the per-iteration dispatch path."""
@@ -33,6 +35,7 @@ def test_fused_matches_per_iteration_exactly():
         periter.predict(X[:400], raw_score=True))
 
 
+@pytest.mark.slow
 def test_fused_bagging_and_feature_fraction():
     X, y = _xy()
     bst = lgb.train({"objective": "binary", "verbosity": -1,

@@ -110,6 +110,7 @@ def test_traversal_matches_replay_efb():
                           _replay_scores(bst._impl, X[:200]))
 
 
+@pytest.mark.slow
 def test_traversal_num_iteration_truncation():
     X, y = make_binary(n=400, f=6)
     bst = lgb.train({"objective": "binary", "num_leaves": 15,
@@ -122,6 +123,7 @@ def test_traversal_num_iteration_truncation():
             _replay_scores(bst._impl, Xq, ntrees=ntrees)), ntrees
 
 
+@pytest.mark.slow
 def test_traversal_multiclass():
     rng = np.random.RandomState(5)
     X = rng.rand(600, 8).astype(np.float32)
@@ -159,6 +161,7 @@ def test_engine_traversal_vs_replay_backends(raw):
 
 
 # ------------------------------------------------------------ cascade
+@pytest.mark.slow
 def test_cascade_margin_inf_is_bit_identical():
     X, y = make_binary(n=500, f=8)
     bst = lgb.train({"objective": "binary", "num_leaves": 31,
@@ -182,6 +185,7 @@ def test_cascade_margin_zero_serves_stage_one_only():
     assert np.array_equal(stage1, casc)
 
 
+@pytest.mark.slow
 def test_cascade_engine_end_to_end():
     """A cascade engine with a generous margin must still match the full
     model on confident rows and stay within the margin bound elsewhere;
@@ -198,6 +202,7 @@ def test_cascade_engine_end_to_end():
 
 
 # ------------------------------------------------------------ quantized leaves
+@pytest.mark.slow
 def test_quantized_leaves_close_not_exact():
     X, y = make_binary(n=500, f=8)
     bst = lgb.train({"objective": "binary", "num_leaves": 31,
@@ -215,6 +220,7 @@ def test_quantized_leaves_close_not_exact():
 
 
 # ------------------------------------------------------------ hot-roll prewarm
+@pytest.mark.slow
 def test_prewarm_hot_roll_zero_recompiles(tmp_path):
     """Staged-generation hot-roll: prewarm compiles the next generation
     off the request path, the generation-aware purge keeps those entries
@@ -271,6 +277,7 @@ def test_generation_aware_purge_without_prewarm(tmp_path):
     assert eng.cache_size() == 0
 
 
+@pytest.mark.slow
 def test_watcher_prewarms_through_engine(tmp_path):
     """watch_dir(engine=...) rolls a newer checkpoint in with zero
     post-warmup recompiles visible to the serving invariant."""
