@@ -1518,14 +1518,23 @@ class GBDT:
         t_disp = t0
         params = self.grow_params
         k = self.num_tree_per_iteration
+        # request-scoped iteration trace (obs/reqtrace.py): a no-op span
+        # unless obs_trace is on; mirrors the serving span tree with
+        # per-wave children under a per-iteration root
+        tspan = obs.trace_iter(iter_idx)
         with obs.span("train_iter", iteration=iter_idx):
+            gspan = tspan.child("gradients")
             g, h, sm = self._stream_pre(
                 self._stream_capture, self.scores, sample_mask,
                 jnp.float32(self._goss_active(iter_idx)), goss_key)
+            gspan.end()
             trees_l, lids_l, aux_l = [], [], []
             for c in range(k):
+                cspan = tspan.child("tree", cls=c)
                 t, li, aux = self._stream_grower.grow(
-                    g[:, c], h[:, c], sm, feature_mask)
+                    g[:, c], h[:, c], sm, feature_mask,
+                    trace_span=cspan if cspan else None)
+                cspan.end()
                 trees_l.append(t)
                 lids_l.append(li)
                 aux_l.append(aux)
@@ -1539,14 +1548,18 @@ class GBDT:
                 mstats = jnp.stack([a[1] for a in aux_l])
             elif params.obs_health:
                 grower_health = jnp.stack(aux_l)
+            pspan = tspan.child("score_commit")
             packed, new_scores, self._stopped_dev, health = \
                 self._stream_post(
                     self._stream_capture, trees, leaf_ids, self.scores,
                     sm, g, h, grower_health,
                     jnp.float32(self.shrinkage_rate), self._stopped_dev)
+            pspan.end()
             if obs.enabled:
                 t_disp = time.perf_counter()
+                wspan = tspan.child("device_wait")
                 jax.block_until_ready(new_scores)  # lgbm-lint: disable=LGL103 span close
+                wspan.end()
         t_done = time.perf_counter() if obs.enabled else 0.0
         self.scores = new_scores
 
@@ -1562,12 +1575,14 @@ class GBDT:
             obs.dispatch_done(iter_idx, 1, t_done - t0,
                               health_rows=hrow,
                               busy_s=t_disp - t0, wait_s=t_done - t_disp)
+            obs.account_rows(self.num_data_orig)
             if obs.per_iteration:
                 obs.record_hbm()
             obs.check_health(hrow, iter_idx, booster=self)
         elif obs.health_enabled:
             obs.check_health(np.asarray(health)[None], iter_idx,
                              booster=self)
+        tspan.finish("ok")
         if sum(p["count"] for p in self._pending) >= self._flush_every:
             return self._materialize()
         return False
@@ -1937,6 +1952,7 @@ class GBDT:
                                   health_rows=hrows,
                                   busy_s=t_disp - t0,
                                   wait_s=t_done - t_disp)
+                obs.account_rows(self.num_data_orig * block)
                 obs.record_hbm()
                 obs.check_health(hrows, self.iter_ - block, booster=self)
             elif obs.health_enabled:
@@ -2225,6 +2241,7 @@ class GBDT:
             obs.dispatch_done(iter_idx, 1, t_done - t0,
                               health_rows=hrow,
                               busy_s=t_disp - t0, wait_s=t_done - t_disp)
+            obs.account_rows(self.num_data_orig)
             if obs.per_iteration:
                 obs.record_hbm()
             obs.check_health(hrow, iter_idx, booster=self)

@@ -318,6 +318,36 @@ _PARAMS: List[Tuple[str, type, Any, List[str]]] = [
     ("obs_drift_min_rows", int, 256, ["drift_min_rows"]),
     # drift monitoring on the serving predict path; off = zero overhead
     ("serve_drift", bool, True, []),
+    # ---- request-scoped tracing (obs/reqtrace.py) ----
+    # span tree per admitted request / streamed training iteration,
+    # emitted on the event stream with tail-based sampling; off (default)
+    # is the shared no-op span — zero allocation on the hot path and the
+    # compiled programs are byte-identical either way (host-side only)
+    ("obs_trace", bool, False, ["request_trace", "reqtrace"]),
+    # always keep traces at least this slow (ms); shed/error always kept
+    ("obs_trace_slow_ms", float, 250.0, ["trace_slow_ms"]),
+    # fraction of the remaining (fast, ok) traces kept, decided by a
+    # deterministic hash of (seed, trace_id) in [0, 1]
+    ("obs_trace_sample", float, 0.01, ["trace_sample"]),
+    # ---- SLO burn-rate engine (obs/slo.py; /slo on both StatsServers) ----
+    # serving latency objective: p-fraction of requests under this many
+    # ms (objective = serve_slo_target); 0 = no latency SLO
+    ("serve_slo_p99_ms", float, 0.0, ["slo_p99_ms"]),
+    # good-fraction the latency SLO targets (0.99 => 1% error budget)
+    ("serve_slo_target", float, 0.99, []),
+    # availability objective: fraction of requests NOT errored/shed/timed
+    # out (e.g. 0.999); 0 = no availability SLO
+    ("serve_slo_availability", float, 0.0, ["slo_availability"]),
+    # streamed-training throughput floor (rows/sec); 0 = no training SLO
+    ("train_slo_rows_per_sec", float, 0.0, ["slo_rows_per_sec"]),
+    # Google-SRE multi-window burn rates: fast window for responsiveness,
+    # slow window to ride out blips; burning when BOTH exceed the warn
+    # threshold (burn 1.0 = consuming exactly the error budget)
+    ("slo_fast_window_s", float, 300.0, []),
+    ("slo_slow_window_s", float, 3600.0, []),
+    ("slo_burn_warn", float, 2.0, ["slo_burn_threshold"]),
+    # seconds between background SLO evaluations (serving ticker)
+    ("slo_tick_s", float, 5.0, []),
     # ---- resilience (lightgbm_tpu.resilience; docs/Resilience.md) ----
     # deterministic fault plan: comma list of kind@unit:match[:arg], e.g.
     # "kv_timeout@round:2,kill@iter:7,serve_error@req:50". Strictly
@@ -691,6 +721,41 @@ class Config:
         if self.obs_drift_min_rows < 0:
             raise LightGBMError("obs_drift_min_rows should be >= 0, got %s"
                                 % self.obs_drift_min_rows)
+        if self.obs_trace_slow_ms < 0:
+            raise LightGBMError("obs_trace_slow_ms should be >= 0, got %s"
+                                % self.obs_trace_slow_ms)
+        if not 0.0 <= self.obs_trace_sample <= 1.0:
+            raise LightGBMError("obs_trace_sample should be in [0, 1], "
+                                "got %s" % self.obs_trace_sample)
+        if self.serve_slo_p99_ms < 0:
+            raise LightGBMError("serve_slo_p99_ms should be >= 0 "
+                                "(0 = no latency SLO), got %s"
+                                % self.serve_slo_p99_ms)
+        if not 0.0 < self.serve_slo_target < 1.0:
+            raise LightGBMError("serve_slo_target should be in (0, 1), "
+                                "got %s" % self.serve_slo_target)
+        if not 0.0 <= self.serve_slo_availability < 1.0:
+            raise LightGBMError("serve_slo_availability should be in "
+                                "[0, 1) (0 = no availability SLO), got %s"
+                                % self.serve_slo_availability)
+        if self.train_slo_rows_per_sec < 0:
+            raise LightGBMError("train_slo_rows_per_sec should be >= 0 "
+                                "(0 = no training SLO), got %s"
+                                % self.train_slo_rows_per_sec)
+        if self.slo_fast_window_s <= 0 or self.slo_slow_window_s <= 0:
+            raise LightGBMError(
+                "slo_fast_window_s/slo_slow_window_s should be > 0")
+        if self.slo_fast_window_s > self.slo_slow_window_s:
+            raise LightGBMError("slo_fast_window_s (%s) should not exceed "
+                                "slo_slow_window_s (%s)"
+                                % (self.slo_fast_window_s,
+                                   self.slo_slow_window_s))
+        if self.slo_burn_warn <= 0:
+            raise LightGBMError("slo_burn_warn should be > 0, got %s"
+                                % self.slo_burn_warn)
+        if self.slo_tick_s <= 0:
+            raise LightGBMError("slo_tick_s should be > 0, got %s"
+                                % self.slo_tick_s)
         self.serving_backend = str(self.serving_backend).strip().lower()
         if self.serving_backend not in SERVING_BACKENDS:
             raise LightGBMError("serving_backend should be one of %s, got %s"

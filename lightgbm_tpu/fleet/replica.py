@@ -372,6 +372,10 @@ class FleetClusterProvider:
         return {"fleet": summary, "replicas": fleet}
 
     def cluster_prometheus(self) -> str:
+        # replica names come from config/CLI, so label VALUES must be
+        # escaped per exposition 0.0.4 (backslash, quote, newline) — a
+        # replica named `a"b` used to emit an unparseable line here
+        from ..obs.registry import escape_label_value
         snap = self.cluster_stats()
         lines = [
             "# HELP lgbm_fleet_replica_up Replica announced within lease.",
@@ -391,7 +395,8 @@ class FleetClusterProvider:
         for name in sorted(snap["replicas"]):
             doc = snap["replicas"][name]
             lines.append('lgbm_fleet_replica_up{replica="%s"} %d'
-                         % (name, 1 if doc.get("live") else 0))
+                         % (escape_label_value(name),
+                            1 if doc.get("live") else 0))
         for metric, path, help_text in gauges:
             lines.append("# HELP %s %s" % (metric, help_text))
             lines.append("# TYPE %s gauge" % metric)
@@ -401,7 +406,8 @@ class FleetClusterProvider:
                        else doc.get(path[0], {}).get(path[1]))
                 if val is None:
                     continue
-                lines.append('%s{replica="%s"} %s' % (metric, name, val))
+                lines.append('%s{replica="%s"} %s'
+                             % (metric, escape_label_value(name), val))
         s = snap["fleet"]
         lines += [
             "# HELP lgbm_fleet_live_replicas Live replicas in the fleet.",

@@ -19,6 +19,14 @@ serving:
 - ``health``: host dispatch for device-side health flags (non-finite
   grad/hess, zero-positive-gain waves) that the training step piggy-backs
   on existing reductions — warn, checkpoint-and-abort, or raise.
+- ``reqtrace``: request-scoped span trees with tail-based sampling —
+  one trace per admitted serving request (propagated across fleet hops
+  via the ``x-lgbm-trace`` header) or per streamed training iteration,
+  emitted as ``span`` events on the shared EventStream.
+- ``slo``: declarative SLOs (latency/availability/throughput) judged as
+  Google-SRE multi-window burn rates over registry metrics; ``/slo`` on
+  both StatsServers, ``lgbm_slo_*`` gauges, warn-only HealthMonitor
+  routing.
 - ``server``: an optional lightweight stats HTTP endpoint during training
   (Prometheus text + JSON snapshot + healthz + federated cluster routes).
 - ``distributed``: multi-process telemetry — metric federation (global
@@ -41,8 +49,13 @@ from .costmodel import (CHIP_PEAKS, CostModel, detect_peaks,  # noqa: F401
                         get_cost_model, roofline_snapshot)
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry, Summary, get_registry)
+from .reqtrace import (NULL_REQ_SPAN, NULL_TRACER,  # noqa: F401
+                       NullRequestTracer, ReqSpan, RequestTracer,
+                       TRACE_HEADER, format_trace_header, keep_decision,
+                       new_trace_id, parse_trace_header)
 from .runtime import TrainingObs, resolve_health_action  # noqa: F401
 from .server import StatsServer  # noqa: F401
+from .slo import SloEngine, SloSpec  # noqa: F401
 from .trace import (EventStream, Tracer, perfetto_trace,  # noqa: F401
                     span)
 from .distributed import (DistributedObs, FlightRecorder,  # noqa: F401

@@ -109,6 +109,10 @@ class HealthMonitor:
             "lgbm_drift_reports_total",
             "Train/serve drift reports routed through the health monitor "
             "(warn-only; drift never escalates).")
+        self._c_slo_burn = reg.counter(
+            "lgbm_slo_burn_reports_total",
+            "SLO budget-burn reports routed through the health monitor "
+            "(warn-only; a burning budget never escalates).")
 
     def anomaly_count(self) -> int:
         return int(self._c_anomaly.value)
@@ -151,6 +155,29 @@ class HealthMonitor:
             self._events.write("health", iteration=0, kind=r.kind,
                                message=r.message, model=str(model_id),
                                max_psi=round(float(max_psi), 4))
+        Log.warning("health: %s" % r.message)
+        return r
+
+    def note_slo_burn(self, slo: str, fast_burn: float, slow_burn: float,
+                      observed: float, objective: float,
+                      kind: str = "") -> HealthReport:
+        """Record an SLO flipping to burning (obs/slo.py).  Like drift,
+        a burning error budget warns and counts but NEVER escalates — it
+        is the arming signal for the refit/hot-roll loop, not a reason to
+        kill a process that is still serving."""
+        r = HealthReport(
+            0, "slo_burn",
+            "SLO %s is burning its error budget: fast-window burn %.2fx, "
+            "slow-window burn %.2fx (observed %.4g vs %s objective %.4g)"
+            % (str(slo), float(fast_burn), float(slow_burn),
+               float(observed), str(kind) or "the", float(objective)))
+        self.reports.append(r)
+        self._c_slo_burn.inc()
+        if self._events is not None:
+            self._events.write("health", iteration=0, kind=r.kind,
+                               message=r.message, slo=str(slo),
+                               fast_burn=round(float(fast_burn), 4),
+                               slow_burn=round(float(slow_burn), 4))
         Log.warning("health: %s" % r.message)
         return r
 

@@ -41,6 +41,20 @@ def _escape_label(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping (backslash, newline, quote) for
+    exposition emitted OUTSIDE this module — e.g. the fleet's hand-built
+    per-replica rows, where a hostile replica/model name must not be able
+    to smuggle extra labels or break a scrape."""
+    return _escape_label(v)
+
+
+def _escape_help(v: str) -> str:
+    # HELP escaping per the 0.0.4 text format: backslash then line feed
+    # (label-value quoting does NOT apply here)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_suffix(labels: Tuple[Tuple[str, str], ...],
                   extra: Tuple[Tuple[str, str], ...] = ()) -> str:
     items = tuple(labels) + tuple(extra)
@@ -159,8 +173,13 @@ class Summary(_Metric):
             return self._sum
 
     def quantiles(self) -> Dict[float, float]:
+        # copy under the lock, sort OUTSIDE it: the O(n log n) sort over
+        # the 4096-sample window must not stall hot-path observe() calls
+        # while a scrape serializes (tests/test_obs_export.py hammers
+        # this exact interleaving)
         with self._lock:
-            data = sorted(self._window)
+            data = list(self._window)
+        data.sort()
         if not data:
             return {q: 0.0 for q in _QUANTILES}
         out = {}
@@ -274,6 +293,13 @@ class Histogram(_Metric):
         # finite bound is the best (and only finite) answer
         return self._bounds[-1]
 
+    def bucket_counts(self) -> Tuple[Tuple[float, ...], Tuple[int, ...]]:
+        """``(bounds, per-bucket counts)`` copy — counts are NON-cumulative
+        and the trailing entry is the +Inf overflow bucket.  The SLO
+        engine reads bad-fractions from this."""
+        with self._lock:
+            return self._bounds, tuple(self._counts)
+
     def samples(self):
         with self._lock:
             counts = list(self._counts)
@@ -359,26 +385,38 @@ class MetricsRegistry:
             return list(self._metrics.values())
 
     # ------------------------------------------------------------ export
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4.  Families sorted by
-        name, series by label string — the output is deterministic for a
-        given registry state (the golden test pins it)."""
+    def collect(self):
+        """Point-in-time sample gather: ``(global_labels, [(name, kind,
+        help, [(sample_name, labels, value), ...]), ...])`` sorted by
+        family name then label set.  Every lock (registry map, each
+        metric's state) is released before this returns — serialization
+        (Prometheus text, JSON) happens on the caller's time, never while
+        a hot path waits to observe.  Both exposition routes and the
+        StatsServer build their bodies from this."""
         with self._lock:
             extra = self._global_labels
         families: Dict[str, List[_Metric]] = {}
         for m in self.metrics():
             families.setdefault(m.name, []).append(m)
-        lines = []
+        out = []
         for name in sorted(families):
             group = families[name]
-            help_txt = self._help.get(name, "")
-            if help_txt:
-                lines.append("# HELP %s %s"
-                             % (name, help_txt.replace("\n", " ")))
-            lines.append("# TYPE %s %s" % (name, group[0].kind))
             rows = []
             for m in sorted(group, key=lambda m: m.labels):
                 rows.extend(m.samples())
+            out.append((name, group[0].kind, self._help.get(name, ""), rows))
+        return extra, out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4.  Families sorted by
+        name, series by label string — the output is deterministic for a
+        given registry state (the golden test pins it)."""
+        extra, families = self.collect()
+        lines = []
+        for name, kind, help_txt, rows in families:
+            if help_txt:
+                lines.append("# HELP %s %s" % (name, _escape_help(help_txt)))
+            lines.append("# TYPE %s %s" % (name, kind))
             for sample_name, labels, value in rows:
                 lines.append("%s%s %s"
                              % (sample_name, _label_suffix(labels, extra),
@@ -388,11 +426,10 @@ class MetricsRegistry:
     def snapshot(self) -> Dict:
         """Flat JSON view: ``name{k="v"}`` -> value (summaries expand to
         quantile/sum/count keys)."""
-        with self._lock:
-            extra = self._global_labels
+        extra, families = self.collect()
         out: Dict[str, float] = {}
-        for m in self.metrics():
-            for sample_name, labels, value in m.samples():
+        for _, _, _, rows in families:
+            for sample_name, labels, value in rows:
                 out[sample_name + _label_suffix(labels, extra)] = value
         return {"ts": round(time.time(), 3), "metrics": out}
 

@@ -27,7 +27,9 @@ from typing import Optional
 from ..log import Log
 from .health import HealthMonitor
 from .registry import get_registry
+from .reqtrace import NULL_REQ_SPAN, NULL_TRACER, RequestTracer
 from .server import StatsServer
+from .slo import SloEngine
 from .trace import EventStream, PerfettoWindow, Tracer, _NULL_SPAN
 
 LEVELS = {"none": 0, "basic": 1, "full": 2}
@@ -81,6 +83,15 @@ class TrainingObs:
             "lgbm_train_device_bytes_in_use",
             "Live device memory (allocator bytes_in_use; live-array sum "
             "as fallback).")
+        self._c_rows = self.registry.counter(
+            "lgbm_train_rows_total",
+            "Training rows processed (rows x iterations completed) — the "
+            "train_slo_rows_per_sec throughput source.")
+        # request-scoped tracing of the training loop (obs/reqtrace.py):
+        # one root per streamed iteration, per-wave children; the same
+        # tail-sampling machinery the serving path uses
+        self.reqtrace = NULL_TRACER
+        self.slo: Optional[SloEngine] = None
 
     # ------------------------------------------------------------ setup
     @classmethod
@@ -141,6 +152,26 @@ class TrainingObs:
                 warn_skew=getattr(config, "obs_straggler_warn_skew", 2.0))
             if stats is not None:
                 stats.set_cluster(obs.dist)
+        if level > 0 and getattr(config, "obs_trace", False):
+            obs.reqtrace = RequestTracer(
+                events=events,
+                slow_ms=getattr(config, "obs_trace_slow_ms", 250.0),
+                sample=getattr(config, "obs_trace_sample", 0.01),
+                seed=getattr(config, "seed", 0))
+        floor = getattr(config, "train_slo_rows_per_sec", 0.0)
+        if level > 0 and floor > 0:
+            obs.slo = SloEngine(
+                fast_window_s=getattr(config, "slo_fast_window_s", 300.0),
+                slow_window_s=getattr(config, "slo_slow_window_s", 3600.0),
+                burn_warn=getattr(config, "slo_burn_warn", 2.0),
+                monitor=obs.monitor)
+            obs.slo.add_throughput_slo(
+                "train_throughput", "lgbm_train_rows_total", floor,
+                description="training rows/sec floor "
+                            "(train_slo_rows_per_sec)")
+            obs.slo.start(getattr(config, "slo_tick_s", 5.0))
+            if stats is not None:
+                stats.set_slo(obs.slo)
         return obs
 
     def _make_monitor(self, action: str) -> None:
@@ -202,6 +233,23 @@ class TrainingObs:
     def perfetto_step(self, lo: int, hi: int) -> None:
         if self.perfetto is not None:
             self.perfetto.step(lo, hi)
+
+    def trace_iter(self, iteration: int, **fields):
+        """Root span for one training iteration (streamed path).  Returns
+        the shared no-op span when request tracing is off, so the caller
+        threads it unconditionally; finish() runs the tail-sampling
+        keep/drop like any serving request."""
+        if not self.reqtrace.enabled:
+            return NULL_REQ_SPAN
+        return self.reqtrace.start_trace("train_iter",
+                                         iteration=int(iteration), **fields)
+
+    def account_rows(self, rows: int) -> None:
+        """Rows processed by one completed dispatch — the throughput-SLO
+        source (rows x iterations, so a 5-iteration block over 1M rows
+        accounts 5M)."""
+        if rows > 0:
+            self._c_rows.inc(int(rows))
 
     def dispatch_done(self, start_iter: int, count: int, dur_s: float,
                       health_rows=None, busy_s=None, wait_s=None,
@@ -277,6 +325,8 @@ class TrainingObs:
         (CI smoke, notebooks) can scrape final state before exit."""
         if self.perfetto is not None:
             self.perfetto.close()
+        if self.slo is not None:
+            self.slo.stop()
         if self.events is not None:
             self.events.write(
                 "train_done",

@@ -14,6 +14,9 @@ metrics registry while a training run is live:
   scrape never triggers a collective.  Single-process (or before
   ``StatsServer.set_cluster`` wires a provider) these are exactly the
   local ``/metrics`` / ``/stats`` bodies.
+- ``GET /slo``      -> SLO burn-rate judgment (obs/slo.py): every
+  declared objective's fast/slow-window burn rate and burning flag, or
+  ``{"status": "disabled"}`` when no SLO engine is wired here.
 - ``GET /drift``    -> per-model train/serve drift status (obs/drift.py):
   every registered DriftMonitor's PSI/JS per feature + score sketch, or
   ``{"status": "no_profile"}`` when nothing monitors drift here.  The
@@ -47,6 +50,7 @@ class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None
     anomaly_counter = None
     cluster = None   # DistributedObs (or None): set via set_cluster()
+    slo = None       # SloEngine (or None): set via set_slo()
 
     def log_message(self, fmt, *args):  # quiet: route through our logger
         Log.debug("obs.server: " + fmt % args)
@@ -95,6 +99,11 @@ class _Handler(BaseHTTPRequestHandler):
                 from .drift import drift_snapshot
                 self._send(200, json.dumps(drift_snapshot(),
                                            sort_keys=True).encode(),
+                           "application/json")
+            elif self.path == "/slo":
+                body = (self.slo.status() if self.slo is not None
+                        else {"status": "disabled", "slos": {}})
+                self._send(200, json.dumps(body, sort_keys=True).encode(),
                            "application/json")
             elif self.path == "/roofline":
                 # lazy import: costmodel itself is jax-free at module
@@ -150,6 +159,11 @@ class StatsServer:
         ``cluster_stats()``).  Without a provider the routes serve the
         local registry — the single-process degenerate case."""
         self._handler.cluster = provider
+
+    def set_slo(self, engine) -> None:
+        """Wire ``/slo`` to an obs.slo.SloEngine (anything with
+        ``status()``); without one the route reports disabled."""
+        self._handler.slo = engine
 
     def start(self) -> "StatsServer":
         self._thread = threading.Thread(

@@ -1,0 +1,327 @@
+"""Declarative SLOs evaluated as multi-window burn rates.
+
+An SLO here is a budgeted objective over metrics the registry already
+collects — no new instrumentation on any hot path:
+
+- ``latency``: fraction of requests over a latency threshold, read from
+  a Prometheus :class:`~lightgbm_tpu.obs.registry.Histogram`'s cumulative
+  bucket counts (summed across label sets, so per-sink serving series
+  aggregate correctly);
+- ``availability``: errors + shed + timeouts vs total requests, read
+  from Counters;
+- ``throughput``: a rows/sec floor for training, read from a Counter's
+  rate.
+
+Evaluation follows the Google-SRE multi-window burn-rate recipe: the
+engine keeps a timestamped ring of raw source samples and derives the
+bad-fraction over a fast window (default 5m) and a slow window (default
+1h); ``burn = bad_fraction / error_budget`` where the budget is
+``1 - objective``.  An SLO is *burning* when both windows exceed
+``slo_burn_warn`` — the fast window makes the alarm responsive, the slow
+window keeps a brief blip from tripping it (early in a process's life
+both windows clamp to the available history, so a sustained breach still
+flips within one fast window — pinned by ``tools/slo_smoke.py``).
+
+Results are exported three ways: ``lgbm_slo_*`` gauges on the same
+registry (federated by the PR-9 cluster merge like any other metric), a
+JSON ``status()`` document served as ``/slo`` on both StatsServers, and
+a warn-only route through :class:`~lightgbm_tpu.obs.health.HealthMonitor`
+(``note_slo_burn``) plus an optional ``on_burn`` callback — the seam a
+fleet uses to arm the drift→refit→hot-roll loop off a burning budget.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import registry as _registry
+from .registry import Counter, Gauge, Histogram
+
+_EPS = 1e-9
+
+
+class SloSpec:
+    """One declarative objective.  ``kind`` is ``latency`` |
+    ``availability`` | ``throughput``; ``objective`` is the good-fraction
+    target for the budgeted kinds (e.g. 0.99 => 1% error budget) and the
+    rows/sec floor for ``throughput``."""
+
+    def __init__(self, name: str, kind: str, objective: float,
+                 source: str = "", bad_sources: Sequence[str] = (),
+                 threshold_ms: float = 0.0, description: str = ""):
+        self.name = str(name)
+        self.kind = str(kind)
+        self.objective = float(objective)
+        self.source = str(source)
+        self.bad_sources = tuple(bad_sources)
+        self.threshold_ms = float(threshold_ms)
+        self.description = str(description)
+
+    def budget(self) -> float:
+        """Error budget as a fraction; throughput floors have none."""
+        if self.kind == "throughput":
+            return 0.0
+        return max(1.0 - self.objective, _EPS)
+
+    def describe(self) -> Dict:
+        doc = {"kind": self.kind, "objective": self.objective,
+               "source": self.source, "description": self.description}
+        if self.kind == "latency":
+            doc["threshold_ms"] = self.threshold_ms
+        if self.bad_sources:
+            doc["bad_sources"] = list(self.bad_sources)
+        return doc
+
+
+def _histogram_totals(reg, name: str, threshold: float) -> Tuple[float, float]:
+    """``(total, over_threshold)`` summed across every Histogram series
+    named ``name`` regardless of labels.  ``le`` is inclusive, so when
+    the threshold falls inside a bucket the whole bucket counts as bad —
+    a conservative rounding that can only over-report burn."""
+    total = over = 0.0
+    for m in reg.metrics():
+        if m.name != name or not isinstance(m, Histogram):
+            continue
+        bounds, counts = m.bucket_counts()
+        t = float(sum(counts))
+        i = bisect.bisect_left(bounds, threshold)
+        if i < len(bounds) and bounds[i] == threshold:
+            good = float(sum(counts[:i + 1]))
+        else:
+            good = float(sum(counts[:i]))
+        total += t
+        over += t - good
+    return total, over
+
+
+def _counter_total(reg, name: str) -> float:
+    return float(sum(m.value for m in reg.metrics()
+                     if m.name == name and isinstance(m, (Counter, Gauge))))
+
+
+class SloEngine:
+    """Samples SLO sources into a time ring and judges burn rates.
+
+    Thread-safe; ``tick()`` is cheap (a registry scan) and is driven
+    either by ``start(period_s)``'s daemon thread or synchronously by
+    ``status()`` (so an ``/slo`` scrape is always fresh)."""
+
+    def __init__(self, registry=None, fast_window_s: float = 300.0,
+                 slow_window_s: float = 3600.0, burn_warn: float = 2.0,
+                 monitor=None, on_burn: Optional[Callable] = None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.registry = (registry if registry is not None
+                         else _registry.get_registry())
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_warn = float(burn_warn)
+        self.monitor = monitor
+        self.on_burn = on_burn
+        self._time = time_fn
+        self._specs: List[SloSpec] = []
+        # ring of (t, {slo_name: (bad, total)}) raw cumulative samples
+        self._history: collections.deque = collections.deque()
+        self._burning: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ---------------------------------------------------------- declare
+    def add_latency_slo(self, name: str, histogram: str,
+                        threshold_ms: float, objective: float = 0.99,
+                        description: str = "") -> SloSpec:
+        spec = SloSpec(name, "latency", objective, source=histogram,
+                       threshold_ms=threshold_ms, description=description)
+        self._add(spec)
+        return spec
+
+    def add_availability_slo(self, name: str, requests: str,
+                             bad: Sequence[str], objective: float,
+                             description: str = "") -> SloSpec:
+        spec = SloSpec(name, "availability", objective, source=requests,
+                       bad_sources=bad, description=description)
+        self._add(spec)
+        return spec
+
+    def add_throughput_slo(self, name: str, counter: str,
+                           floor_per_s: float,
+                           description: str = "") -> SloSpec:
+        spec = SloSpec(name, "throughput", floor_per_s, source=counter,
+                       description=description)
+        self._add(spec)
+        return spec
+
+    def _add(self, spec: SloSpec) -> None:
+        with self._lock:
+            self._specs.append(spec)
+            self._burning.setdefault(spec.name, False)
+
+    def specs(self) -> List[SloSpec]:
+        with self._lock:
+            return list(self._specs)
+
+    # ----------------------------------------------------------- sample
+    def _sample(self, spec: SloSpec) -> Tuple[float, float]:
+        """Cumulative ``(bad, total)`` right now.  For throughput the
+        'total' is the cumulative row count and 'bad' is unused."""
+        if spec.kind == "latency":
+            total, over = _histogram_totals(self.registry, spec.source,
+                                            spec.threshold_ms)
+            return over, total
+        if spec.kind == "availability":
+            bad = sum(_counter_total(self.registry, n)
+                      for n in spec.bad_sources)
+            good = _counter_total(self.registry, spec.source)
+            return bad, good + bad
+        return 0.0, _counter_total(self.registry, spec.source)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Record one raw sample of every source into the ring."""
+        t = self._time() if now is None else float(now)
+        with self._lock:
+            sample = {s.name: self._sample(s) for s in self._specs}
+            self._history.append((t, sample))
+            horizon = t - self.slow_window_s - 1.0
+            while len(self._history) > 2 and self._history[1][0] < horizon:
+                self._history.popleft()
+
+    # ------------------------------------------------------------ judge
+    def _window_delta(self, name: str, window_s: float,
+                      now: float) -> Tuple[float, float, float]:
+        """``(d_bad, d_total, dt)`` between the newest sample and the
+        newest sample at least ``window_s`` old (clamped to the oldest
+        available — early-life windows judge whatever history exists)."""
+        cur_t, cur = self._history[-1]
+        cutoff = now - window_s
+        past_t, past = self._history[0]
+        for t, s in reversed(self._history):
+            if t <= cutoff:
+                past_t, past = t, s
+                break
+        cb, ct = cur.get(name, (0.0, 0.0))
+        pb, pt = past.get(name, (0.0, 0.0))
+        return cb - pb, ct - pt, max(cur_t - past_t, 0.0)
+
+    def _judge(self, spec: SloSpec, window_s: float,
+               now: float) -> Dict[str, float]:
+        d_bad, d_total, dt = self._window_delta(spec.name, window_s, now)
+        if spec.kind == "throughput":
+            # no rows EVER means the trainer hasn't started (compile
+            # warmup, setup) — a floor judges a running trainer, so hold
+            # the verdict until the counter first moves
+            _, cum_total = self._history[-1][1].get(spec.name, (0.0, 0.0))
+            rate = d_total / dt if dt > _EPS else 0.0
+            floor = spec.objective
+            burn = (floor / max(rate, _EPS)) \
+                if dt > _EPS and floor > 0 and cum_total > 0 else 0.0
+            return {"burn": burn, "value": rate, "window_s": dt}
+        bad_frac = d_bad / d_total if d_total > _EPS else 0.0
+        return {"burn": bad_frac / spec.budget(), "value": bad_frac,
+                "window_s": dt}
+
+    def evaluate(self, now: Optional[float] = None) -> Dict:
+        """Judge every SLO over both windows, refresh the ``lgbm_slo_*``
+        gauges, and route newly-burning budgets warn-only through the
+        HealthMonitor / ``on_burn`` hook.  Never raises on the hot path."""
+        t = self._time() if now is None else float(now)
+        flips = []
+        with self._lock:
+            if not self._history:
+                return {"slos": {}, "burn_warn": self.burn_warn,
+                        "fast_window_s": self.fast_window_s,
+                        "slow_window_s": self.slow_window_s}
+            out: Dict[str, Dict] = {}
+            for spec in self._specs:
+                fast = self._judge(spec, self.fast_window_s, t)
+                slow = self._judge(spec, self.slow_window_s, t)
+                burning = (fast["burn"] >= self.burn_warn
+                           and slow["burn"] >= self.burn_warn)
+                was = self._burning.get(spec.name, False)
+                self._burning[spec.name] = burning
+                doc = spec.describe()
+                doc.update(fast_burn=round(fast["burn"], 4),
+                           slow_burn=round(slow["burn"], 4),
+                           observed=round(fast["value"], 6),
+                           fast_span_s=round(fast["window_s"], 3),
+                           slow_span_s=round(slow["window_s"], 3),
+                           burning=burning)
+                out[spec.name] = doc
+                if burning and not was:
+                    flips.append((spec, fast["burn"], slow["burn"],
+                                  fast["value"]))
+                lbl = {"slo": spec.name}
+                self.registry.gauge(
+                    "lgbm_slo_burn_rate", "SLO burn rate (fast window)",
+                    labels=dict(lbl, window="fast")).set(fast["burn"])
+                self.registry.gauge(
+                    "lgbm_slo_burn_rate", "SLO burn rate (slow window)",
+                    labels=dict(lbl, window="slow")).set(slow["burn"])
+                self.registry.gauge(
+                    "lgbm_slo_burning",
+                    "1 when both burn windows exceed slo_burn_warn",
+                    labels=lbl).set(1.0 if burning else 0.0)
+                self.registry.gauge(
+                    "lgbm_slo_value",
+                    "Observed bad-fraction (or rows/sec) over the fast "
+                    "window", labels=lbl).set(fast["value"])
+            status = {"slos": out, "burn_warn": self.burn_warn,
+                      "fast_window_s": self.fast_window_s,
+                      "slow_window_s": self.slow_window_s}
+        # warn routing OUTSIDE the engine lock: the monitor writes events
+        # and logs, and a callback may do arbitrary work
+        for spec, fast_burn, slow_burn, observed in flips:
+            if self.monitor is not None:
+                try:
+                    self.monitor.note_slo_burn(
+                        spec.name, fast_burn=fast_burn,
+                        slow_burn=slow_burn, observed=observed,
+                        objective=spec.objective, kind=spec.kind)
+                except Exception:
+                    pass
+            if self.on_burn is not None:
+                try:
+                    self.on_burn(spec.name, fast_burn=fast_burn,
+                                 slow_burn=slow_burn, observed=observed)
+                except Exception:
+                    pass
+        return status
+
+    def burning(self, name: str) -> bool:
+        with self._lock:
+            return self._burning.get(name, False)
+
+    def status(self) -> Dict:
+        """Fresh sample + judgment — the ``/slo`` response body."""
+        self.tick()
+        return self.evaluate()
+
+    # ------------------------------------------------------------ drive
+    def start(self, period_s: float = 5.0) -> "SloEngine":
+        """Background ticker; idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(period_s):
+                try:
+                    self.tick()
+                    self.evaluate()
+                except Exception:
+                    pass            # judging must never kill the process
+
+        self.tick()
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="lgbm-slo")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None

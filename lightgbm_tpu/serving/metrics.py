@@ -262,32 +262,40 @@ class ServingMetrics:
     # ------------------------------------------------------------ export
     def snapshot(self) -> Dict:
         by_bucket = self.bucket_latency()
+        # copy the windows under the lock; the percentile math, dict
+        # build and (at the caller) JSON serialization all run OUTSIDE
+        # it — record_request() on the hot path must never wait on a
+        # stats scrape (tests/test_obs_export.py pins the interleaving)
         with self._lock:
-            lat = latency_summary(list(self._lat_window))
-            rows_per_batch = (float(sum(self._batch_rows))
-                              / max(len(self._batch_rows), 1))
-            return {
-                "ts": round(time.time(), 3),
-                "uptime_s": round(time.time() - self._t0, 3),
-                "requests": self.requests,
-                "rows": self.rows,
-                "batches": self.batches,
-                "rows_per_batch": round(rows_per_batch, 2),
-                "queue_depth": self.queue_depth,
-                "queue_rows": self.queue_rows,
-                "cache_hits": self.cache_hits,
-                "cache_misses": self.cache_misses,
-                "errors": self.errors,
-                "shed": self.shed,
-                "request_timeouts": self.request_timeouts,
-                "rollbacks": self.rollbacks,
-                "backend_compiles": backend_compile_count(),
-                "recompiles_after_warmup":
-                    backend_compile_count() - self._compile_floor,
-                "warmup_credit_compiles": self._warmup_credit_compiles,
-                "warmup_credit_misses": self._warmup_credit_misses,
-                "latency_ms": lat,
-                "predict_latency_ms_by_bucket": by_bucket,
+            lat_window = list(self._lat_window)
+            batch_rows = list(self._batch_rows)
+            compile_floor = self._compile_floor
+            credit_compiles = self._warmup_credit_compiles
+            credit_misses = self._warmup_credit_misses
+        lat = latency_summary(lat_window)
+        rows_per_batch = float(sum(batch_rows)) / max(len(batch_rows), 1)
+        return {
+            "ts": round(time.time(), 3),
+            "uptime_s": round(time.time() - self._t0, 3),
+            "requests": self.requests,
+            "rows": self.rows,
+            "batches": self.batches,
+            "rows_per_batch": round(rows_per_batch, 2),
+            "queue_depth": self.queue_depth,
+            "queue_rows": self.queue_rows,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "errors": self.errors,
+            "shed": self.shed,
+            "request_timeouts": self.request_timeouts,
+            "rollbacks": self.rollbacks,
+            "backend_compiles": backend_compile_count(),
+            "recompiles_after_warmup":
+                backend_compile_count() - compile_floor,
+            "warmup_credit_compiles": credit_compiles,
+            "warmup_credit_misses": credit_misses,
+            "latency_ms": lat,
+            "predict_latency_ms_by_bucket": by_bucket,
             }
 
     def write_jsonl(self, path_or_fh) -> Dict:

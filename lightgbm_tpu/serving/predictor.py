@@ -329,12 +329,19 @@ class ServingEngine:
     # ------------------------------------------------------------ predict
     def predict(self, model_id: str, X, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
-                _record_request: bool = True) -> np.ndarray:
+                _record_request: bool = True, _span=None) -> np.ndarray:
         """Serve one request; output matches ``Booster.predict`` (same f32
         accumulation order, same transform) for any request size.
         ``_record_request=False`` is for the micro-batch queue, which
         accounts its callers itself (per-caller count + queue-inclusive
-        latency) so a fused dispatch is not double-counted."""
+        latency) so a fused dispatch is not double-counted.
+
+        ``_span`` is an optional trace span (obs/reqtrace.py): when
+        present, each bucket pass is split into a ``device_dispatch``
+        child (the async jit call returning a device future) and a
+        ``device_wait`` child (the host blocking on the transfer) — the
+        split only exists on the traced path; the untraced fast path is
+        the exact pre-trace statement, same compiled entries either way."""
         t0 = time.perf_counter()
         # serve_predict seam: "request" = dispatched predict, counted by
         # the plan's per-point counter (fused queue batches count once)
@@ -349,6 +356,11 @@ class ServingEngine:
                   "model %r expects %d features, request has %d"
                   % (model_id, bundle.num_features, X.shape[1]))
         iters = bundle.effective_iterations(num_iteration)
+        if _span is not None and self.cascade_trees > 0:
+            # cascade stages run inside the compiled program; the trace
+            # records the configuration the pass was compiled against
+            _span.annotate(cascade_trees=self.cascade_trees,
+                           cascade_margin=self.cascade_margin)
         n = X.shape[0]
         outs = []
         for lo in range(0, n, self.max_batch):
@@ -360,7 +372,15 @@ class ServingEngine:
                 xpad[:xc.shape[0]] = xc
             entry = self._predictor(bundle, b, raw_score, iters)
             t1 = time.perf_counter()
-            out = np.asarray(entry(xpad), np.float64)[:xc.shape[0]]
+            if _span is not None:
+                dspan = _span.child("device_dispatch", bucket=b)
+                dev = entry(xpad)
+                dspan.end()
+                wspan = _span.child("device_wait", bucket=b)
+                out = np.asarray(dev, np.float64)[:xc.shape[0]]
+                wspan.end()
+            else:
+                out = np.asarray(entry(xpad), np.float64)[:xc.shape[0]]
             self.metrics.record_batch(b)
             self.metrics.record_bucket_latency(
                 b, (time.perf_counter() - t1) * 1000.0)

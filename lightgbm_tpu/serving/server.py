@@ -18,7 +18,15 @@ HTTP API:
                   DriftMonitors (obs/drift.py; warn-only, never 503s)
   GET  /drift     per-model train/serve drift detail: PSI/JS per feature
                   vs the bundled training profile + the score sketch
+  GET  /slo       burn-rate verdicts per declared SLO (obs/slo.py) —
+                  {"slos": {...}} or {"status": "disabled"} without one
+  GET  /traces    recently KEPT request traces (tail sampling) with their
+                  span records — obs_trace=true only, else empty
   GET  /models    registered model ids + shapes
+
+POST /predict honors an inbound ``x-lgbm-trace: <trace_id>[-<span_id>]``
+header (obs/reqtrace.py) so fleet peers and load generators keep one
+trace id across hops.
 
 stdin mode (``serve_stdin=true``) speaks the same request objects, one JSON
 object per line, replies one JSON line each — the subprocess-friendly
@@ -37,6 +45,7 @@ import numpy as np
 from ..config import Config
 from ..log import Log, LightGBMError, OverloadedError
 from ..obs.registry import get_registry
+from ..obs.reqtrace import TRACE_HEADER
 from ..resilience.breaker import CircuitBreaker
 from .batching import MicroBatchQueue
 from .metrics import ServingMetrics
@@ -72,10 +81,13 @@ class ServingApp:
         self.coordinator = None    # fleet.replica.RollingDeployCoordinator
         self.watcher = None        # serving.registry.CheckpointWatcher
         self.cluster = None        # fleet.replica.FleetClusterProvider
+        self.tracer = None         # obs.reqtrace.RequestTracer
+        self.slo = None            # obs.slo.SloEngine
+        self.trace_events = None   # EventStream owned by build_app
         self.queue.start()
 
     # ------------------------------------------------------------ requests
-    def handle_predict(self, req: Dict) -> Dict:
+    def handle_predict(self, req: Dict, trace: Optional[str] = None) -> Dict:
         model_id = req.get("model", "")
         if not model_id:
             ids = self.engine.registry.ids()
@@ -101,7 +113,7 @@ class ServingApp:
         try:
             out = self.queue.predict(
                 model_id, X, raw_score=bool(req.get("raw_score", False)),
-                num_iteration=req.get("num_iteration"))
+                num_iteration=req.get("num_iteration"), trace=trace)
         except OverloadedError:
             raise          # admission shed: not an engine failure
         except Exception:
@@ -125,7 +137,11 @@ class ServingApp:
                 part.stop()
         if self.watcher is not None:
             self.watcher.stop()
+        if self.slo is not None:
+            self.slo.stop()
         self.queue.stop()
+        if self.trace_events is not None:
+            self.trace_events.close()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -202,6 +218,19 @@ class _Handler(BaseHTTPRequestHandler):
             # monitors publish into
             from ..obs.drift import drift_snapshot
             self._reply(200, drift_snapshot())
+        elif self.path == "/slo":
+            # burn-rate verdicts (docs/Observability.md): ticks + evaluates
+            # on demand so a scrape always sees current windows, even when
+            # the background ticker period is long
+            body = (self.app.slo.status() if self.app.slo is not None
+                    else {"status": "disabled", "slos": {}})
+            self._reply(200, body)
+        elif self.path == "/traces":
+            # most recent KEPT traces (tail sampling), newest last — the
+            # quick "what did the slow request spend its time on" view
+            body = (self.app.tracer.recent_traces()
+                    if self.app.tracer is not None else [])
+            self._reply(200, {"traces": body})
         elif self.path == "/models":
             self._reply(200, self.app.handle_models())
         else:
@@ -214,7 +243,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length", "0"))
             req = json.loads(self.rfile.read(length) or b"{}")
-            self._reply(200, self.app.handle_predict(req))
+            # inbound trace context (x-lgbm-trace: <trace_id>[-<span_id>]):
+            # a fleet peer or load generator continues its trace through
+            # this replica; absent/malformed headers mint a fresh trace
+            trace = self.headers.get(TRACE_HEADER)
+            self._reply(200, self.app.handle_predict(req, trace=trace))
         except OverloadedError as e:
             # shed (bounded admission) or breaker-open: 503 + Retry-After
             self._reply(503, {"error": str(e),
@@ -302,15 +335,52 @@ def build_app(config: Config) -> ServingApp:
         from ..fleet.qos import QosPolicy
         qos = QosPolicy.from_spec(config.serve_qos_weights,
                                   config.serve_qos_quota_rows)
+    tracer = None
+    trace_events = None
+    if config.obs_trace:
+        from ..obs.reqtrace import RequestTracer
+        from ..obs.trace import EventStream
+        if config.obs_event_file:
+            trace_events = EventStream(
+                config.obs_event_file,
+                static_fields={"source": "serve",
+                               "replica": config.fleet_replica or ""})
+        tracer = RequestTracer(events=trace_events,
+                               slow_ms=config.obs_trace_slow_ms,
+                               sample=config.obs_trace_sample,
+                               seed=config.seed)
     app = ServingApp(
         engine,
         MicroBatchQueue(engine, deadline_ms=config.serve_deadline_ms,
                         max_queue_rows=config.serve_max_queue_rows,
                         request_timeout_ms=config.serve_request_timeout_ms,
-                        qos=qos),
+                        qos=qos, tracer=tracer),
         breaker=CircuitBreaker(
             failure_threshold=config.serve_breaker_failures,
             cooldown_s=config.serve_breaker_cooldown_s))
+    app.tracer = tracer
+    app.trace_events = trace_events
+    if config.serve_slo_p99_ms > 0 or config.serve_slo_availability > 0:
+        from ..obs.slo import SloEngine
+        slo = SloEngine(fast_window_s=config.slo_fast_window_s,
+                        slow_window_s=config.slo_slow_window_s,
+                        burn_warn=config.slo_burn_warn,
+                        monitor=engine._drift_health())
+        if config.serve_slo_p99_ms > 0:
+            slo.add_latency_slo(
+                "serve_p99", "lgbm_serving_request_latency_ms",
+                threshold_ms=config.serve_slo_p99_ms,
+                objective=config.serve_slo_target,
+                description="fraction of requests under serve_slo_p99_ms")
+        if config.serve_slo_availability > 0:
+            slo.add_availability_slo(
+                "serve_availability", "lgbm_serving_requests_total",
+                bad=["lgbm_serving_errors_total",
+                     "lgbm_serving_shed_total",
+                     "lgbm_serving_request_timeouts_total"],
+                objective=config.serve_slo_availability,
+                description="requests neither errored, shed nor expired")
+        app.slo = slo.start(config.slo_tick_s)
     if config.serve_latency_budget_ms > 0:
         from ..fleet.qos import CascadeAutotuner
         app.tuner = CascadeAutotuner(

@@ -327,15 +327,27 @@ class StreamFrontierGrower:
 
     # ----------------------------------------------------------------- grow
     def grow(self, grad: jnp.ndarray, hess: jnp.ndarray,
-             sample_mask: jnp.ndarray, feature_mask: jnp.ndarray
+             sample_mask: jnp.ndarray, feature_mask: jnp.ndarray,
+             trace_span=None
              ) -> Tuple[TreeArrays, jnp.ndarray, Optional[jnp.ndarray]]:
         """Grow one tree. ``grad``/``hess``/``sample_mask`` are full
         padded-length device arrays; ``sample_mask`` must already be 0 on
-        padding rows (and on bagged-out / GOSS-dropped rows)."""
+        padding rows (and on bagged-out / GOSS-dropped rows).
+
+        ``trace_span`` (obs/reqtrace.py, optional) gets one child per
+        frontier wave — chunk-transfer wait (the pipeline's ``wait_s``
+        delta) vs host dispatch time, plus the fused last-chunk commit —
+        mirroring the serving request span tree on the training side.
+        Pure host bookkeeping: the dispatched programs are identical with
+        tracing on or off."""
         pipe = self.pipeline
         R = pipe.chunk_rows
         meshed = self.mesh is not None
         sample_mask = sample_mask.astype(jnp.float32)
+        tspan = trace_span if trace_span else None
+        if tspan is not None:
+            rspan = tspan.child("root_sweep", chunks=pipe.num_chunks)
+            w_mark = pipe.wait_s
         root_g, root_h, root_c = self._root_sums(grad, hess, sample_mask)
         acc = self._zero_root_acc if meshed \
             else jnp.zeros(self._hist_shape, jnp.float32)
@@ -346,12 +358,19 @@ class StreamFrontierGrower:
                                    sample_mask, acc)
         state = self._root_commit(acc, root_g, root_h, root_c,
                                   feature_mask)
+        if tspan is not None:
+            rspan.end(transfer_wait_ms=round(
+                (pipe.wait_s - w_mark) * 1000.0, 3))
 
         last = pipe.num_chunks - 1
         while True:
             do, plan = self._wave_begin(state.best, state.tree.num_leaves)
             if not bool(do):          # the one host sync per wave
                 break
+            if tspan is not None:
+                wspan = tspan.child("wave", wave=self.waves,
+                                    chunks=pipe.num_chunks)
+                w_mark = pipe.wait_s
             hist_acc = self._zero_wave_acc if meshed \
                 else jnp.zeros((self.wave_width,) + self._hist_shape,
                                jnp.float32)
@@ -372,6 +391,10 @@ class StreamFrontierGrower:
                 dispatches += 1
             self.waves += 1
             self.wave_dispatches += dispatches
+            if tspan is not None:
+                wspan.end(dispatches=dispatches, fused_commit=True,
+                          transfer_wait_ms=round(
+                              (pipe.wait_s - w_mark) * 1000.0, 3))
 
         self.trees_grown += 1
         if self.params.obs_modelstats:

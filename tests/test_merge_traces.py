@@ -1,0 +1,135 @@
+"""tools/merge_events.py span-tree reconstruction across process hops.
+
+Contracts pinned here:
+- a trace that hops processes (``x-lgbm-trace`` header → ``ctx``) merges
+  back into ONE tree: the downstream root is a child of the upstream
+  span that minted the header, roots/children resolve across streams;
+- legacy per-phase Tracer records (``event: "span"`` but no ``trace``
+  field) are invisible to the reconstruction — the two span vocabularies
+  share an event name but never mix;
+- spans whose parent was never merged in land in ``orphans`` — listed,
+  tolerated, never an error (a partial post-mortem beats none);
+- tail-based sampling replays deterministically: two tracers with the
+  same seed keep exactly the same trace ids (the property the reqtrace
+  module docstring pins on this file);
+- the CLI round-trip: ``--span-trees`` writes the same trees the library
+  call returns.
+"""
+import json
+import os
+import sys
+
+from lightgbm_tpu.obs.reqtrace import RequestTracer, format_trace_header
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.obs.trace import EventStream
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+
+import merge_events   # noqa: E402  (tools/ is not a package)
+
+
+def _tracer(path, process, **kw):
+    events = EventStream(str(path), static_fields={"process": process})
+    kw.setdefault("sample", 1.0)
+    return RequestTracer(events=events, registry=MetricsRegistry(), **kw), \
+        events
+
+
+def _two_hop_streams(tmp_path):
+    """Frontend (process 0) hands the trace to a backend (process 1) via
+    the header; each writes its own event file.  Returns (paths, ids)."""
+    t0, ev0 = _tracer(tmp_path / "events.0.jsonl", 0)
+    t1, ev1 = _tracer(tmp_path / "events.1.jsonl", 1)
+    front = t0.start_trace("request", model="m")
+    hop = front.child("fleet_hop", target="replica-b")
+    header = format_trace_header(hop)
+    back = t1.start_trace("request", ctx=header)
+    back.child("predict").end()
+    back.finish("ok")
+    hop.end()
+    front.finish("ok")
+    ev0.close()
+    ev1.close()
+    return ([str(tmp_path / "events.0.jsonl"),
+             str(tmp_path / "events.1.jsonl")],
+            {"trace": front.trace_id, "front": front.span_id,
+             "hop": hop.span_id, "back": back.span_id})
+
+
+def test_cross_process_trace_reassembles_into_one_tree(tmp_path):
+    paths, ids = _two_hop_streams(tmp_path)
+    merged = list(merge_events.merge(paths))
+    assert all("stream" in r for r in merged)
+    trees = merge_events.build_span_trees(merged)
+    assert set(trees) == {ids["trace"]}
+    tree = trees[ids["trace"]]
+    assert len(tree["spans"]) == 4 and tree["orphans"] == []
+    assert [r["span_id"] for r in tree["roots"]] == [ids["front"]]
+    by_id = {s["span_id"]: s for s in tree["spans"]}
+    # the downstream root is a CHILD of the upstream hop span
+    assert ids["back"] in by_id[ids["hop"]]["children"]
+    assert by_id[ids["back"]]["parent"] == ids["hop"]
+    # streams still attribute each side of the hop
+    assert by_id[ids["front"]]["process"] == 0
+    assert by_id[ids["back"]]["process"] == 1
+
+
+def test_unmerged_upstream_becomes_orphan_not_error(tmp_path):
+    paths, ids = _two_hop_streams(tmp_path)
+    trees = merge_events.build_span_trees(
+        merge_events.merge(paths[1:]))      # backend stream only
+    tree = trees[ids["trace"]]
+    assert [s["span_id"] for s in tree["orphans"]] == [ids["back"]]
+    assert tree["roots"] == []              # true root lives upstream
+    assert len(tree["spans"]) == 2          # still a usable partial view
+
+
+def test_legacy_phase_spans_invisible_to_trees(tmp_path):
+    path = tmp_path / "events.jsonl"
+    ev = EventStream(str(path))
+    # legacy Tracer vocabulary: same event name, "span" key, no "trace"
+    ev.write("span", span="train", iteration=3, duration_s=0.5)
+    ev.write("metrics", value=1)
+    t, _ = _tracer(path, 0)
+    root = t.start_trace("train_iter")
+    root.finish("ok")
+    ev.close()
+    trees = merge_events.build_span_trees(merge_events.merge([str(path)]))
+    assert set(trees) == {root.trace_id}
+    assert len(trees[root.trace_id]["spans"]) == 1
+
+
+def test_sampling_replays_deterministically(tmp_path):
+    ids = ["%016x" % (i * 2654435761) for i in range(300)]
+
+    def kept_set(path, seed):
+        t, ev = _tracer(path, 0, sample=0.3, seed=seed)
+        for tid in ids:
+            t.start_trace("request", ctx=(tid, None)).finish("ok")
+        ev.close()
+        with open(path) as fh:
+            return {json.loads(line)["trace"] for line in fh}
+
+    a = kept_set(tmp_path / "a.jsonl", seed=42)
+    b = kept_set(tmp_path / "b.jsonl", seed=42)
+    assert a == b and 0 < len(a) < len(ids)     # replica processes agree
+    c = kept_set(tmp_path / "c.jsonl", seed=43)
+    assert a != c                               # policy is seed-keyed
+
+
+def test_cli_span_trees_roundtrip(tmp_path, monkeypatch, capsys):
+    paths, ids = _two_hop_streams(tmp_path)
+    out = tmp_path / "timeline.jsonl"
+    trees_path = tmp_path / "trees.json"
+    monkeypatch.setattr(sys, "argv",
+                        ["merge_events.py"] + paths +
+                        ["--out", str(out), "--span-trees", str(trees_path)])
+    assert merge_events.main() == 0
+    with open(trees_path) as fh:
+        trees = json.load(fh)
+    assert set(trees) == {ids["trace"]}
+    assert len(trees[ids["trace"]]["spans"]) == 4
+    with open(out) as fh:
+        merged = [json.loads(line) for line in fh]
+    assert merge_events.build_span_trees(merged).keys() == trees.keys()

@@ -1,0 +1,157 @@
+"""Metric exposition hardening: hostile labels + snapshot-vs-record races.
+
+Contracts pinned here:
+- Prometheus label VALUES escape backslash, quote and newline per the
+  0.0.4 text format — both in the registry's own exposition and in the
+  fleet's hand-built per-replica rows (``cluster_prometheus``), where a
+  replica named ``a"b`` used to emit an unparseable line;
+- HELP text escapes backslash and newline (quote rules do NOT apply);
+- every emitted line matches the exposition grammar, and escaped label
+  values round-trip back to the original string;
+- Summary.quantiles() and ServingMetrics.snapshot() copy under their
+  locks and serialize OUTSIDE them: hammering observers while scraping
+  never throws, and the final counts come out exact.
+"""
+import json
+import re
+import threading
+import time
+
+from lightgbm_tpu.fleet.replica import FileKvClient, FleetClusterProvider
+from lightgbm_tpu.obs.registry import (MetricsRegistry, escape_label_value,
+                                       _escape_help)
+from lightgbm_tpu.serving.metrics import ServingMetrics
+
+HOSTILE = 'a"b\\c\nd'   # quote, backslash and newline in one value
+
+# one exposition line: name, optional {labels} with escaped values, value
+_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\\\|\\"|\\n|[^"\\\n])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\\\|\\"|\\n|[^"\\\n])*")*\})?'
+    r' \S+$')
+
+
+def _assert_parseable(text):
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _LINE.match(line), "unparseable exposition line: %r" % line
+
+
+def _unescape(v):
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+# --------------------------------------------------------------- escaping
+def test_escape_label_value_roundtrip():
+    escaped = escape_label_value(HOSTILE)
+    assert "\n" not in escaped and '"' not in escaped.replace('\\"', "")
+    assert _unescape(escaped) == HOSTILE
+
+
+def test_registry_exposition_with_hostile_labels():
+    reg = MetricsRegistry()
+    reg.counter("lgbm_x_total", "X.", labels={"model": HOSTILE}).inc(3)
+    text = reg.prometheus_text()
+    assert text == (
+        '# HELP lgbm_x_total X.\n'
+        '# TYPE lgbm_x_total counter\n'
+        'lgbm_x_total{model="a\\"b\\\\c\\nd"} 3\n')
+    _assert_parseable(text)
+    val = re.search(r'model="((?:\\.|[^"\\])*)"', text).group(1)
+    assert _unescape(val) == HOSTILE
+
+
+def test_hostile_global_labels_escaped():
+    reg = MetricsRegistry()
+    reg.set_global_labels({"replica": HOSTILE})
+    reg.counter("lgbm_y_total", "Y.").inc()
+    _assert_parseable(reg.prometheus_text())
+
+
+def test_help_text_escaping():
+    assert _escape_help("a\\b\nc") == "a\\\\b\\nc"
+    reg = MetricsRegistry()
+    reg.counter("lgbm_z_total", "line one\nline two \\ slash")
+    text = reg.prometheus_text()
+    assert "# HELP lgbm_z_total line one\\nline two \\\\ slash\n" in text
+    assert len([ln for ln in text.splitlines() if ln]) == 3  # no split line
+
+
+# -------------------------------------------------- fleet cluster export
+def test_cluster_prometheus_hostile_replica_name(tmp_path):
+    kv = FileKvClient(str(tmp_path / "kv"))
+    for name, snap_id in ((HOSTILE, 3), ("sane", 4)):
+        kv.key_value_set("fleet/" + name, json.dumps({
+            "replica": name, "time": time.time(), "snap_id": snap_id,
+            "metrics": {"requests": 10, "shed": 1,
+                        "recompiles_after_warmup": 0}}))
+    text = FleetClusterProvider(kv).cluster_prometheus()
+    _assert_parseable(text)
+    assert 'lgbm_fleet_replica_up{replica="a\\"b\\\\c\\nd"} 1' in text
+    assert 'lgbm_fleet_replica_snap_id{replica="sane"} 4' in text
+    assert "lgbm_fleet_live_replicas 2" in text
+    # the hostile name round-trips out of its label value
+    vals = {_unescape(m) for m in
+            re.findall(r'lgbm_fleet_replica_up\{replica="((?:\\.|[^"\\])*)"',
+                       text)}
+    assert vals == {HOSTILE, "sane"}
+
+
+# ----------------------------------------------- snapshot-vs-record races
+def _hammer(record, scrape, n_threads=4, per_thread=2000):
+    """Run ``record`` from many threads while ``scrape`` loops; surface
+    any scraper exception after the join."""
+    errors = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                scrape()
+            except Exception as e:          # pragma: no cover - the bug
+                errors.append(e)
+                return
+
+    scr = threading.Thread(target=scraper)
+    scr.start()
+    threads = [threading.Thread(
+        target=lambda t=t: [record(t, i) for i in range(per_thread)])
+        for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    scr.join()
+    assert not errors, errors[0]
+    return n_threads * per_thread
+
+
+def test_summary_quantiles_concurrent_with_observe():
+    reg = MetricsRegistry()
+    s = reg.summary("lgbm_lat", "L.", window=512)
+    total = _hammer(lambda t, i: s.observe(t + i * 1e-3),
+                    lambda: (s.quantiles(), reg.prometheus_text()))
+    assert s.count == total
+    q = s.quantiles()
+    assert set(q) == {0.5, 0.9, 0.99} and q[0.5] <= q[0.99]
+
+
+def test_serving_metrics_snapshot_concurrent_with_recording():
+    m = ServingMetrics(window=256)
+
+    def record(t, i):
+        m.record_request(rows=2, latency_s=0.001 * (i % 7))
+        m.record_bucket_latency(16, 0.5 + i % 3)
+        if i % 10 == 0:
+            m.record_cache(hit=True)
+
+    total = _hammer(record, lambda: (m.snapshot(), m.bucket_latency()))
+    snap = m.snapshot()
+    assert snap["requests"] == total            # exact under concurrency
+    assert snap["rows"] == 2 * total
+    assert snap["latency_ms"]["count"] > 0
+    assert "16" in m.bucket_latency()

@@ -1,0 +1,250 @@
+"""obs.reqtrace — request-scoped span trees with tail-based sampling.
+
+Contracts pinned here:
+- header parse/format round-trip: ``x-lgbm-trace`` carries
+  ``<trace_id>`` or ``<trace_id>-<parent_span_id>``; malformed values
+  parse to None (a bad client header must never fail admission);
+- tail sampling is deterministic: ``keep_decision`` is a pure function
+  of (seed, trace_id), slow and shed/error roots are ALWAYS kept, and
+  nothing is emitted before the root finishes (the decision needs the
+  final duration and status);
+- the batch span rides the first member's trace, links every member,
+  and is emitted exactly ONCE no matter how many member traces keep;
+- tracing off is the shared no-op singleton: ``child`` returns itself,
+  truthiness is False, and no records exist anywhere;
+- tracing ON changes nothing the compiler sees: warmed serving traffic
+  with a sample=1.0 tracer still takes zero predictor-cache misses and
+  zero XLA backend compiles (the load_test/slo_smoke gate in miniature).
+"""
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.obs.reqtrace import (NULL_REQ_SPAN, NULL_TRACER,
+                                       NullRequestTracer, RequestTracer,
+                                       format_trace_header, keep_decision,
+                                       new_trace_id, parse_trace_header)
+from lightgbm_tpu.obs.trace import EventStream
+from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _tracer(sample=1.0, slow_ms=1e9, seed=0):
+    """Tracer writing to an in-memory stream + private registry; returns
+    (tracer, read_records)."""
+    buf = io.StringIO()
+    events = EventStream(buf)
+    t = RequestTracer(events=events, slow_ms=slow_ms, sample=sample,
+                      seed=seed, registry=MetricsRegistry())
+
+    def records():
+        return [json.loads(line) for line in
+                buf.getvalue().splitlines() if line.strip()]
+    return t, records
+
+
+# ------------------------------------------------------------ propagation
+def test_header_roundtrip():
+    assert parse_trace_header("deadbeef") == ("deadbeef", None)
+    assert parse_trace_header("deadbeef-c0de") == ("deadbeef", "c0de")
+    assert parse_trace_header("DEADBEEF-C0DE") == ("deadbeef", "c0de")
+    assert parse_trace_header("  deadbeef  ") == ("deadbeef", None)
+    # malformed → None, never an exception
+    for bad in (None, "", "xyz-1", "12 34", "g" * 8, "a" * 33, "-abc"):
+        assert parse_trace_header(bad) is None
+    # non-hex parent degrades to no-parent (the id itself still honored)
+    assert parse_trace_header("deadbeef-zz") == ("deadbeef", None)
+
+
+def test_format_header_parses_back():
+    t, _ = _tracer()
+    root = t.start_trace("request")
+    tid, parent = parse_trace_header(format_trace_header(root))
+    assert tid == root.trace_id and parent == root.span_id
+    root.finish()
+
+
+def test_inbound_ctx_honored():
+    t, _ = _tracer()
+    a = t.start_trace("request", ctx="c0ffee11-aa55")
+    assert a.trace_id == "c0ffee11" and a.parent_id == "aa55"
+    b = t.start_trace("request", ctx=("feedface", None))
+    assert b.trace_id == "feedface" and b.parent_id is None
+    c = t.start_trace("request", ctx="not a header!!")
+    assert len(c.trace_id) == 16 and c.parent_id is None   # fresh trace
+    for s in (a, b, c):
+        s.finish()
+
+
+# ---------------------------------------------------------- keep decision
+def test_keep_decision_deterministic_and_calibrated():
+    ids = ["%016x" % i for i in range(4000)]
+    kept = {i for i in ids if keep_decision(i, 0.25, seed=7)}
+    kept2 = {i for i in ids if keep_decision(i, 0.25, seed=7)}
+    assert kept == kept2                               # pure function
+    assert abs(len(kept) / len(ids) - 0.25) < 0.05     # calibrated
+    kept_other = {i for i in ids if keep_decision(i, 0.25, seed=8)}
+    assert kept != kept_other                          # seed matters
+    assert not any(keep_decision(i, 0.0, seed=7) for i in ids[:100])
+    assert all(keep_decision(i, 1.0, seed=7) for i in ids[:100])
+
+
+# -------------------------------------------------- buffering + emission
+def test_span_tree_emitted_only_at_root_finish():
+    t, records = _tracer(sample=1.0)
+    root = t.start_trace("request", model="m", rows=4)
+    child = root.child("queue_wait")
+    child.end(status="ok")
+    assert records() == []                  # buffered, not emitted
+    mid = root.child("predict")
+    mid.child("device_wait", bucket=16).end()
+    mid.end()
+    root.finish("ok", latency_ms=1.0)
+    recs = records()
+    assert all(r["event"] == "span" and r["trace"] == root.trace_id
+               for r in recs)
+    by_name = {r["name"]: r for r in recs}
+    assert set(by_name) == {"request", "queue_wait", "predict",
+                            "device_wait"}
+    assert by_name["queue_wait"]["parent"] == root.span_id
+    assert by_name["device_wait"]["parent"] == by_name["predict"]["span_id"]
+    assert by_name["request"]["parent"] is None
+    assert by_name["request"]["model"] == "m"
+    assert by_name["device_wait"]["bucket"] == 16
+    for r in recs:
+        assert r["dur_ms"] >= 0.0 and r["status"] == "ok"
+        assert "t0" in r and "seq" in r     # EventStream stamping intact
+
+
+def test_tail_sampling_slow_and_bad_always_kept():
+    t, records = _tracer(sample=0.0, slow_ms=1e9)
+    t.start_trace("request").finish("ok")
+    assert records() == []                          # fast + ok → dropped
+    t.start_trace("request").finish("shed", error="queue full")
+    t.start_trace("request").finish("error", error="bad features")
+    assert {r["status"] for r in records()} == {"shed", "error"}
+    assert t._kept_bad.value == 2 and t._kept.value == 2
+    # slow keeps regardless of sample
+    t2, records2 = _tracer(sample=0.0, slow_ms=0.0)
+    t2.start_trace("request").finish("ok")
+    assert len(records2()) == 1 and t2._kept_slow.value == 1
+    reasons = [s["reason"] for s in t2.recent_traces()]
+    assert reasons == ["slow"]
+
+
+def test_dropped_trace_leaves_no_record_and_counts():
+    t, records = _tracer(sample=0.0)
+    for _ in range(5):
+        root = t.start_trace("request")
+        root.child("queue_wait").end()
+        root.finish("ok")
+    assert records() == [] and t.recent_traces() == []
+    assert t._started.value == 5 and t._kept.value == 0
+
+
+def test_context_manager_marks_error_status():
+    t, records = _tracer(sample=0.0)       # only kept if status != ok
+    with pytest.raises(RuntimeError):
+        with t.start_trace("request"):
+            raise RuntimeError("boom")
+    recs = records()
+    assert len(recs) == 1 and recs[0]["status"] == "error"
+
+
+# ------------------------------------------------------------- batch span
+def test_batch_span_linked_and_emitted_once():
+    t, records = _tracer(sample=0.0, slow_ms=1e9)
+    a = t.start_trace("request")
+    b = t.start_trace("request")
+    batch = t.batch_span("batch", [a, b], requests=2)
+    batch.child("predict", model="m").end()
+    batch.finish("ok")                      # dependent root: no emission
+    assert records() == []
+    a.finish("error", error="x")            # kept → batch emitted with it
+    recs_a = records()
+    names_a = [r["name"] for r in recs_a]
+    assert names_a.count("batch") == 1 and names_a.count("predict") == 1
+    b.finish("error", error="y")            # kept too → batch NOT re-emitted
+    names_all = [r["name"] for r in records()]
+    assert names_all.count("batch") == 1 and names_all.count("predict") == 1
+    batch_rec = next(r for r in records() if r["name"] == "batch")
+    # batch rides the FIRST member's trace, links carry both members
+    assert batch_rec["trace"] == a.trace_id
+    assert batch_rec["parent"] == a.span_id
+    assert batch_rec["links"] == ["%s-%s" % (a.trace_id, a.span_id),
+                                  "%s-%s" % (b.trace_id, b.span_id)]
+    # every member's request record points back at the batch span
+    for root in (a, b):
+        rec = next(r for r in records()
+                   if r["name"] == "request" and r["trace"] == root.trace_id)
+        assert rec["batch"] == "%s-%s" % (batch.trace_id, batch.span_id)
+
+
+def test_batch_span_empty_members_is_noop():
+    t, _ = _tracer()
+    assert t.batch_span("batch", []) is NULL_REQ_SPAN
+    assert t.batch_span("batch", [None, NULL_REQ_SPAN]) is NULL_REQ_SPAN
+
+
+# ------------------------------------------------------------ null objects
+def test_null_span_and_tracer_are_inert():
+    assert not NULL_REQ_SPAN
+    assert NULL_REQ_SPAN.child("anything", deep=1) is NULL_REQ_SPAN
+    NULL_REQ_SPAN.annotate(x=1)
+    NULL_REQ_SPAN.end("error")
+    NULL_REQ_SPAN.finish("error")
+    with NULL_REQ_SPAN as s:
+        assert s is NULL_REQ_SPAN
+    assert NULL_TRACER.enabled is False
+    assert NULL_TRACER.start_trace("request") is NULL_REQ_SPAN
+    assert NULL_TRACER.batch_span("b", [NULL_REQ_SPAN]) is NULL_REQ_SPAN
+    assert NullRequestTracer().recent_traces() == []
+
+
+def test_new_trace_id_shape():
+    tid = new_trace_id()
+    assert len(tid) == 16 and parse_trace_header(tid) == (tid, None)
+
+
+# ------------------------------------- serving integration + recompile pin
+def test_traced_serving_zero_recompiles_and_full_tree():
+    """Tracing at sample=1.0 through the live micro-batch queue: every
+    request keeps a full span tree (request → queue_wait, batch →
+    predict → device spans), client-minted ids survive, and the
+    post-warmup compile counters stay at ZERO — tracing is host-side
+    bookkeeping the compiled programs never see."""
+    from lightgbm_tpu.serving import install_compile_hook
+    install_compile_hook()
+    eng = ServingEngine(max_batch=64, min_bucket=16)
+    eng.registry.load_file("m", os.path.join(GOLDEN, "model_ref.txt"))
+    nf = eng.registry.get("m").num_features
+    eng.warmup()
+    t, records = _tracer(sample=1.0)
+    q = MicroBatchQueue(eng, deadline_ms=2, tracer=t).start()
+    rng = np.random.RandomState(5)
+    try:
+        mine = new_trace_id()
+        futs = [q.submit("m", rng.rand(3, nf).astype(np.float32),
+                         trace=mine if i == 0 else None)
+                for i in range(6)]
+        for f in futs:
+            assert f.result(timeout=60).shape == (3,)
+    finally:
+        q.stop()
+    assert eng.metrics.cache_misses_after_warmup() == 0
+    assert eng.metrics.recompiles_after_warmup() == 0
+    recs = records()
+    roots = [r for r in recs if r["name"] == "request"]
+    assert len(roots) == 6
+    assert mine in {r["trace"] for r in roots}      # propagation survived
+    names = {r["name"] for r in recs}
+    assert {"request", "queue_wait", "batch", "predict"} <= names
+    assert "device_dispatch" in names and "device_wait" in names
+    # latency annotated on the kept request spans
+    assert all("latency_ms" in r for r in roots)

@@ -1,0 +1,287 @@
+"""obs.slo — multi-window burn-rate judgment over registry metrics.
+
+Contracts pinned here (all under an injected clock — no sleeps):
+- burn math: ``burn = bad_fraction / (1 - objective)`` from histogram
+  bucket counts (latency), counter sums (availability), and counter
+  rates vs a floor (throughput);
+- the latency threshold is conservative: when it falls strictly inside
+  a bucket the WHOLE bucket counts as bad (``le`` is inclusive, we
+  cannot see inside);
+- multi-window: burning requires BOTH the fast and slow windows over
+  ``burn_warn`` — a brief blip trips the fast window only and stays
+  quiet; early in life both windows clamp to available history so a
+  sustained breach still flips within one fast window;
+- flips are edge-triggered: ``note_slo_burn`` / ``on_burn`` fire once
+  per quiet→burning transition, never per tick;
+- a throughput floor holds its verdict at 0 until the source counter
+  first moves (compile warmup must not page anyone);
+- results export as ``lgbm_slo_*`` gauges on the same registry.
+"""
+import math
+
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.obs.slo import SloEngine, _histogram_totals
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class StubMonitor:
+    def __init__(self):
+        self.calls = []
+
+    def note_slo_burn(self, slo, **kw):
+        self.calls.append((slo, kw))
+
+
+def _engine(reg, clock, fast=10.0, slow=60.0, warn=2.0, monitor=None,
+            on_burn=None):
+    return SloEngine(registry=reg, fast_window_s=fast, slow_window_s=slow,
+                     burn_warn=warn, monitor=monitor, on_burn=on_burn,
+                     time_fn=clock)
+
+
+# ------------------------------------------------------------ latency SLO
+def test_latency_burn_and_edge_triggered_flip():
+    reg = MetricsRegistry()
+    hist = reg.histogram("lat_ms", "latency", buckets=[50.0, 500.0])
+    clock = FakeClock()
+    mon = StubMonitor()
+    eng = _engine(reg, clock, monitor=mon)
+    eng.add_latency_slo("p99", "lat_ms", threshold_ms=50.0, objective=0.99)
+
+    # healthy phase: 100 requests under threshold over 60s
+    for _ in range(10):
+        for _ in range(10):
+            hist.observe(10.0)
+        eng.tick()
+        clock.advance(6.0)
+    st = eng.evaluate()
+    assert st["slos"]["p99"]["fast_burn"] == 0.0
+    assert not st["slos"]["p99"]["burning"] and mon.calls == []
+
+    # sustained breach: 50% of traffic over threshold → bad_frac 0.5,
+    # budget 0.01 → burn 50x on both (clamped) windows
+    for _ in range(12):
+        hist.observe(10.0)
+        hist.observe(200.0)
+        eng.tick()
+        clock.advance(6.0)
+    st = eng.evaluate()
+    doc = st["slos"]["p99"]
+    assert doc["burning"]
+    assert doc["fast_burn"] >= 40.0 and doc["slow_burn"] >= 2.0
+    assert len(mon.calls) == 1 and mon.calls[0][0] == "p99"
+    assert mon.calls[0][1]["kind"] == "latency"
+
+    # still burning on later ticks: no re-fire (edge-triggered)
+    hist.observe(200.0)
+    eng.tick()
+    eng.evaluate()
+    assert len(mon.calls) == 1
+    assert eng.burning("p99")
+
+
+def test_latency_threshold_inside_bucket_is_conservative():
+    reg = MetricsRegistry()
+    hist = reg.histogram("lat_ms", "latency", buckets=[10.0, 100.0])
+    hist.observe(20.0)     # lands in the le=100 bucket
+    total, over = _histogram_totals(reg, "lat_ms", 50.0)
+    assert (total, over) == (1.0, 1.0)    # whole bucket counts as bad
+    # on the exact bucket bound le is inclusive: 20ms <= le=100 is good
+    total, over = _histogram_totals(reg, "lat_ms", 100.0)
+    assert (total, over) == (1.0, 0.0)
+
+
+def test_latency_aggregates_across_label_sets():
+    reg = MetricsRegistry()
+    reg.histogram("lat_ms", "l", labels={"sink": "a"},
+                  buckets=[50.0]).observe(10.0)
+    reg.histogram("lat_ms", "l", labels={"sink": "b"},
+                  buckets=[50.0]).observe(999.0)
+    total, over = _histogram_totals(reg, "lat_ms", 50.0)
+    assert (total, over) == (2.0, 1.0)
+
+
+# ------------------------------------------------------- availability SLO
+def test_availability_burn_from_counters():
+    reg = MetricsRegistry()
+    req = reg.counter("req_total", "r")
+    err = reg.counter("err_total", "e")
+    shed = reg.counter("shed_total", "s")
+    clock = FakeClock()
+    eng = _engine(reg, clock, warn=2.0)
+    eng.add_availability_slo("avail", "req_total",
+                             bad=["err_total", "shed_total"],
+                             objective=0.999)
+    eng.tick()
+    clock.advance(5.0)
+    req.inc(990)
+    err.inc(6)
+    shed.inc(4)
+    eng.tick()
+    st = eng.evaluate()
+    doc = st["slos"]["avail"]
+    # bad_frac = 10/1000 = 0.01, budget 0.001 → burn 10x
+    assert math.isclose(doc["fast_burn"], 10.0, rel_tol=1e-6)
+    assert math.isclose(doc["observed"], 0.01, rel_tol=1e-6)
+    assert doc["burning"]
+
+
+# -------------------------------------------------------- throughput floor
+def test_throughput_floor_holds_verdict_until_rows_flow():
+    reg = MetricsRegistry()
+    rows = reg.counter("rows_total", "rows")
+    clock = FakeClock()
+    mon = StubMonitor()
+    eng = _engine(reg, clock, monitor=mon)
+    eng.add_throughput_slo("tput", "rows_total", floor_per_s=1000.0)
+
+    # compile warmup: ticks pass, counter never moves → burn pinned at 0
+    for _ in range(5):
+        eng.tick()
+        clock.advance(5.0)
+    st = eng.evaluate()
+    assert st["slos"]["tput"]["fast_burn"] == 0.0
+    assert not st["slos"]["tput"]["burning"] and mon.calls == []
+
+    # trainer starts, but slow: 100 rows/s vs 1000 floor → burn 10x
+    rows.inc(500)
+    eng.tick()
+    clock.advance(5.0)
+    rows.inc(500)
+    eng.tick()
+    st = eng.evaluate()
+    doc = st["slos"]["tput"]
+    assert doc["fast_burn"] >= 2.0 and doc["burning"]
+    assert len(mon.calls) == 1 and mon.calls[0][1]["kind"] == "throughput"
+
+    # healthy rate clears the burn
+    clock.advance(5.0)
+    rows.inc(50000)
+    eng.tick()
+    st = eng.evaluate()
+    assert st["slos"]["tput"]["fast_burn"] < 2.0
+
+
+# ----------------------------------------------------------- multi-window
+def test_brief_blip_trips_fast_window_only():
+    reg = MetricsRegistry()
+    hist = reg.histogram("lat_ms", "latency", buckets=[50.0, 500.0])
+    clock = FakeClock()
+    eng = _engine(reg, clock, fast=5.0, slow=120.0)
+    eng.add_latency_slo("p99", "lat_ms", threshold_ms=50.0, objective=0.99)
+    # two minutes of healthy history
+    for _ in range(40):
+        for _ in range(20):
+            hist.observe(10.0)
+        eng.tick()
+        clock.advance(3.0)
+    # a 3s blip of pure badness
+    for _ in range(5):
+        hist.observe(200.0)
+    eng.tick()
+    st = eng.evaluate()
+    doc = st["slos"]["p99"]
+    assert doc["fast_burn"] >= 2.0          # fast window sees the blip
+    assert doc["slow_burn"] < 2.0           # diluted over 2 minutes
+    assert not doc["burning"]               # and so: no page
+
+
+def test_early_life_windows_clamp_to_history():
+    reg = MetricsRegistry()
+    hist = reg.histogram("lat_ms", "latency", buckets=[50.0, 500.0])
+    clock = FakeClock()
+    eng = _engine(reg, clock, fast=300.0, slow=3600.0)
+    eng.add_latency_slo("p99", "lat_ms", threshold_ms=50.0, objective=0.99)
+    eng.tick()
+    clock.advance(2.0)
+    hist.observe(200.0)
+    eng.tick()
+    st = eng.evaluate()
+    doc = st["slos"]["p99"]
+    # 2 seconds into the process's life, both windows judge the same 2s
+    assert doc["fast_span_s"] == doc["slow_span_s"]
+    assert doc["burning"]                   # sustained-from-birth breach
+
+
+def test_history_ring_trims_past_slow_window():
+    reg = MetricsRegistry()
+    reg.counter("rows_total", "rows")
+    clock = FakeClock()
+    eng = _engine(reg, clock, fast=5.0, slow=30.0)
+    eng.add_throughput_slo("tput", "rows_total", floor_per_s=1.0)
+    for _ in range(500):
+        eng.tick()
+        clock.advance(1.0)
+    assert len(eng._history) < 40           # ring, not unbounded growth
+
+
+# ---------------------------------------------------------------- exports
+def test_gauges_and_status_shape():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "r").inc(10)
+    reg.counter("err_total", "e")
+    clock = FakeClock()
+    fired = []
+    eng = _engine(reg, clock,
+                  on_burn=lambda name, **kw: fired.append((name, kw)))
+    eng.add_availability_slo("avail", "req_total", bad=["err_total"],
+                             objective=0.99, description="serve avail")
+    st = eng.status()                       # tick + evaluate in one
+    assert set(st) == {"slos", "burn_warn", "fast_window_s",
+                       "slow_window_s"}
+    doc = st["slos"]["avail"]
+    for key in ("kind", "objective", "fast_burn", "slow_burn", "observed",
+                "fast_span_s", "slow_span_s", "burning", "description"):
+        assert key in doc
+    text = reg.prometheus_text()
+    assert 'lgbm_slo_burn_rate{slo="avail",window="fast"}' in text
+    assert 'lgbm_slo_burning{slo="avail"} 0' in text
+    assert 'lgbm_slo_value{slo="avail"}' in text
+    assert fired == []                      # healthy → callback untouched
+
+    # flip it and check the on_burn callback fires with the numbers
+    reg.counter("err_total", "e").inc(10)
+    eng.tick()
+    eng.evaluate()
+    assert len(fired) == 1 and fired[0][0] == "avail"
+    assert fired[0][1]["fast_burn"] >= 2.0
+    assert 'lgbm_slo_burning{slo="avail"} 1' in reg.prometheus_text()
+
+
+def test_evaluate_with_no_history_is_safe():
+    eng = _engine(MetricsRegistry(), FakeClock())
+    eng.add_latency_slo("p99", "lat_ms", threshold_ms=50.0)
+    assert eng.evaluate()["slos"] == {}
+    assert not eng.burning("p99")
+
+
+def test_broken_monitor_never_breaks_judging():
+    class ExplodingMonitor:
+        def note_slo_burn(self, *a, **k):
+            raise RuntimeError("pager is down")
+
+    reg = MetricsRegistry()
+    req = reg.counter("req_total", "r")
+    err = reg.counter("err_total", "e")
+    clock = FakeClock()
+    eng = _engine(reg, clock, monitor=ExplodingMonitor())
+    eng.add_availability_slo("avail", "req_total", bad=["err_total"],
+                             objective=0.99)
+    eng.tick()
+    clock.advance(5.0)
+    req.inc(1)
+    err.inc(1)
+    eng.tick()
+    st = eng.evaluate()                     # must not raise
+    assert st["slos"]["avail"]["burning"]

@@ -55,6 +55,12 @@ def main() -> int:
                     help="seconds to wait for the watcher to roll")
     ap.add_argument("--parity-sample", type=int, default=16,
                     help="per-phase requests checked against the Booster")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    help="enable request tracing at this tail-sampling "
+                    "rate; every client mints a trace id and propagates "
+                    "it (x-lgbm-trace style) into the queue")
+    ap.add_argument("--trace-slow-ms", type=float, default=250.0,
+                    help="always keep traces at least this slow")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -102,7 +108,13 @@ def main() -> int:
     warmed = engine.warmup()
     t_warm = time.time() - t0
     watcher.start()
-    queue = MicroBatchQueue(engine, deadline_ms=args.deadline_ms).start()
+    tracer = None
+    if args.trace_sample > 0:
+        from lightgbm_tpu.obs.reqtrace import RequestTracer, new_trace_id
+        tracer = RequestTracer(slow_ms=args.trace_slow_ms,
+                               sample=args.trace_sample, seed=args.seed)
+    queue = MicroBatchQueue(engine, deadline_ms=args.deadline_ms,
+                            tracer=tracer).start()
 
     latencies: list = []
     failures: list = []
@@ -117,7 +129,11 @@ def main() -> int:
             for i in range(args.requests):
                 qi = int(r.randint(len(pool)))
                 t1 = time.perf_counter()
-                out = queue.predict("m", pool[qi])
+                # client-minted context, exactly what an HTTP caller
+                # sends in x-lgbm-trace: the kept trace's root carries
+                # the id WE chose, proving propagation end to end
+                ctx = new_trace_id() if tracer is not None else None
+                out = queue.predict("m", pool[qi], trace=ctx)
                 lats.append((time.perf_counter() - t1) * 1000.0)
                 if i < args.parity_sample // max(args.threads, 1) + 1:
                     err = float(np.max(np.abs(out - refs[qi])))
@@ -192,6 +208,8 @@ def main() -> int:
         "client_latency_ms": {"p50": round(p50, 3), "p99": round(p99, 3),
                               "bound_p99": args.p99_ms},
         "device_latency_by_bucket": engine.metrics.bucket_latency(),
+        "traces_kept": (len(tracer.recent_traces())
+                        if tracer is not None else None),
         "metrics": snap,
     }))
     return 0 if not failures else 1

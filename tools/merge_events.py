@@ -113,6 +113,42 @@ def merge(paths: List[str]) -> Iterator[dict]:
                   file=sys.stderr)
 
 
+def build_span_trees(records) -> dict:
+    """Reconstruct request-scoped span trees from merged records.
+
+    A reqtrace span record (obs/reqtrace.py) is an ``event == "span"``
+    line carrying ``trace``/``span_id``/``parent`` — the ``trace`` field
+    distinguishes it from the legacy per-phase Tracer spans, which share
+    the event name.  Returns ``{trace_id: {"spans": [...], "roots":
+    [...], "orphans": [...]}}`` where each span dict gains a
+    ``children`` list of span_ids.  Spans whose ``parent`` is not in the
+    trace (a fleet hop whose upstream stream was not merged in, or a
+    dropped batch trace) land in ``orphans`` — still listed, never an
+    error: a partial post-mortem beats none.
+    """
+    traces: dict = {}
+    for rec in records:
+        if rec.get("event") != "span" or "trace" not in rec:
+            continue
+        traces.setdefault(rec["trace"], []).append(dict(rec))
+    out = {}
+    for tid, spans in traces.items():
+        by_id = {s["span_id"]: s for s in spans}
+        roots, orphans = [], []
+        for s in spans:
+            s.setdefault("children", [])
+        for s in spans:
+            parent = s.get("parent")
+            if parent is None:
+                roots.append(s)
+            elif parent in by_id:
+                by_id[parent]["children"].append(s["span_id"])
+            else:
+                orphans.append(s)
+        out[tid] = {"spans": spans, "roots": roots, "orphans": orphans}
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="merge per-process obs event streams into one "
@@ -121,6 +157,9 @@ def main() -> int:
                     help="JSON-lines event files (streams + crash dumps)")
     ap.add_argument("--out", default="-",
                     help="output path (default: stdout)")
+    ap.add_argument("--span-trees", default="",
+                    help="also write reconstructed request span trees "
+                    "(one JSON object keyed by trace id) to this path")
     args = ap.parse_args()
     for p in args.inputs:
         if not os.path.exists(p):
@@ -128,13 +167,22 @@ def main() -> int:
             return 2
     out = sys.stdout if args.out == "-" else open(args.out, "w")
     n = 0
+    spanbuf = [] if args.span_trees else None
     try:
         for rec in merge(args.inputs):
             out.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+            if spanbuf is not None:
+                spanbuf.append(rec)
             n += 1
     finally:
         if out is not sys.stdout:
             out.close()
+    if spanbuf is not None:
+        trees = build_span_trees(spanbuf)
+        with open(args.span_trees, "w") as fh:
+            json.dump(trees, fh, sort_keys=True, default=str)
+        print("merge_events: %d trace(s) -> %s"
+              % (len(trees), args.span_trees), file=sys.stderr)
     print("merge_events: %d record(s) from %d stream(s)%s"
           % (n, len(args.inputs),
              "" if args.out == "-" else " -> %s" % args.out),
